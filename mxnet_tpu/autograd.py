@@ -1,0 +1,179 @@
+"""Imperative autograd.
+
+Parity: reference ``src/ndarray/autograd.{h,cc}`` + python
+``contrib/autograd.py`` (mark_variables, backward, set_is_training,
+grad_and_loss/grad decorators). The reference records an AGNode tape and
+replays it through a GraphExecutor; here the tape replays as a pure JAX
+function of the marked variables and ``jax.vjp`` produces the gradients —
+the NNVM Gradient pass is jax's AD.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []  # list of (opdef, attrs, input NDArrays, output NDArrays)
+        _state.marked = {}  # id(NDArray) -> grad NDArray
+        _state.grad_reqs = {}
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_is_training(train_mode):
+    """Parity: MXAutogradSetIsTraining. Returns previous state."""
+    st = _st()
+    prev = st.training
+    st.training = bool(train_mode)
+    st.recording = bool(train_mode)
+    return prev
+
+
+class train_section:
+    """``with autograd.train_section():`` — reference contrib/autograd.py."""
+
+    def __enter__(self):
+        self._prev = set_is_training(True)
+        return self
+
+    def __exit__(self, *args):
+        set_is_training(self._prev)
+
+
+class test_section:
+    def __enter__(self):
+        self._prev = set_is_training(False)
+
+    def __exit__(self, *args):
+        set_is_training(self._prev)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to variables (parity: MXAutogradMarkVariables)."""
+    st = _st()
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        st.marked[id(var)] = (var, grad)
+        st.grad_reqs[id(var)] = req
+
+
+def record_op(opdef, attrs, inputs, outputs):
+    """Called by the imperative invoke path while recording."""
+    st = _st()
+    st.tape.append((opdef, dict(attrs), list(inputs), list(outputs)))
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Replay the tape as a jax function of the marked variables and write
+    gradients into their attached buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray
+
+    st = _st()
+    if not st.marked:
+        raise MXNetError("autograd.backward: no variables marked")
+    tape = list(st.tape)
+    var_ids = list(st.marked.keys())
+    var_arrays = [st.marked[i][0] for i in var_ids]
+
+    # map from NDArray identity to its position in the replay environment
+    def replay(var_values):
+        env = {i: v for i, v in zip(var_ids, var_values)}
+
+        def lookup(x):
+            from .ndarray import NDArray as _ND
+
+            if not isinstance(x, _ND):
+                return x  # constant input recorded as a raw array
+            if id(x) in env:
+                return env[id(x)]
+            return x._data
+
+        for opdef, attrs, ins, outs in tape:
+            in_vals = [lookup(x) for x in ins]
+            result = opdef.fcompute(attrs, in_vals, True)
+            for o, v in zip(outs, result):
+                env[id(o)] = v
+        return [env.get(id(o), o._data) for o in outputs]
+
+    primals = [v._data for v in var_arrays]
+    outs, vjp_fn = jax.vjp(lambda *vs: tuple(replay(list(vs))), *primals)
+    if out_grads is None:
+        cts = tuple(jnp.ones_like(o) for o in outs)
+    else:
+        cts = tuple(
+            g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads
+        )
+    grads = vjp_fn(cts)
+    for i, g in zip(var_ids, grads):
+        var, gbuf = st.marked[i]
+        req = st.grad_reqs.get(i, "write")
+        if req == "null":
+            continue
+        if req == "add":
+            gbuf._data = gbuf._data + g
+        else:
+            gbuf._data = g
+    if not retain_graph:
+        st.tape = []
+
+
+def compute_gradient(outputs):
+    """Deprecated reference API alias."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator returning (gradients, loss) (parity contrib/autograd.py)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        from . import ndarray as nd
+        from .ndarray import NDArray
+
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            if not isinstance(x, NDArray):
+                raise MXNetError("variables must be NDArrays")
+        grads = [nd.zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        prev = set_is_training(True)
+        try:
+            outputs = func(*args)
+        finally:
+            set_is_training(prev)
+        backward([outputs] if isinstance(outputs, NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

@@ -1,0 +1,6 @@
+"""Parity: reference ``python/mxnet/contrib/autograd.py`` — the original
+home of the imperative autograd API."""
+from ..autograd import (  # noqa: F401
+    backward, compute_gradient, grad, grad_and_loss, mark_variables,
+    set_is_training, test_section, train_section,
+)

@@ -1,0 +1,201 @@
+"""Host-side dependency engine.
+
+Parity: reference ``src/engine/`` (SURVEY.md §2 N1). On TPU the device-side
+scheduling role of the reference's ThreadedEnginePerDevice is played by
+XLA's async dispatch: jax ops return futures immediately and data
+dependencies serialize execution per device — exactly the WAR/WAW/RAW
+discipline ThreadedVar implements, but tracked by value instead of by
+handle. What remains host-side (file IO, KVStore host reductions, decode
+workers) still benefits from an explicit dependency scheduler, so this
+module provides one with the reference's interface:
+
+- ``push(fn, const_vars, mutable_vars)`` — async execute once deps drain
+  (Engine::PushAsync, engine.h:147)
+- ``Var`` read/write queues (ThreadedVar, threaded_engine.h:93)
+- ``wait_for_var`` / ``wait_for_all`` (WaitForVar/WaitForAll)
+- ``NaiveEngine`` (synchronous) selected via MXNET_ENGINE_TYPE — the same
+  debug escape hatch the reference documents (threaded_engine.h:329).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+from .base import MXNetError, get_env
+
+
+class Var:
+    """A dependency variable with read/write queues (ThreadedVar)."""
+
+    __slots__ = ("_lock", "_queue", "_pending_write", "_num_pending_reads")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = deque()  # of _OprBlock waiting on this var
+        self._pending_write = False
+        self._num_pending_reads = 0
+
+
+class _OprBlock:
+    __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "done", "lock")
+
+    def __init__(self, fn, const_vars, mutable_vars):
+        self.fn = fn
+        self.const_vars = const_vars
+        self.mutable_vars = mutable_vars
+        self.wait = 0
+        self.done = threading.Event()
+        self.lock = threading.Lock()
+
+
+class ThreadedEngine:
+    """Asynchronous host-side dependency engine (ThreadedEnginePooled)."""
+
+    def __init__(self, num_workers=None):
+        if num_workers is None:
+            num_workers = get_env("MXNET_CPU_WORKER_NTHREADS", 4)
+        self._pool = ThreadPoolExecutor(max_workers=num_workers)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._all_done = threading.Condition(self._lock)
+
+    def new_variable(self):
+        return Var()
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        """Schedule fn once all vars' prior conflicting ops complete."""
+        const_vars = list(const_vars)
+        mutable_vars = list(mutable_vars)
+        self._check_duplicate(const_vars, mutable_vars)
+        opr = _OprBlock(fn, const_vars, mutable_vars)
+        with self._lock:
+            self._inflight += 1
+        # append dependencies (AppendReadDependency/AppendWriteDependency)
+        pending = 0
+        for var in const_vars:
+            with var._lock:
+                if var._pending_write or var._queue:
+                    var._queue.append(("r", opr))
+                    pending += 1
+                else:
+                    var._num_pending_reads += 1
+        for var in mutable_vars:
+            with var._lock:
+                if var._pending_write or var._num_pending_reads or var._queue:
+                    var._queue.append(("w", opr))
+                    pending += 1
+                else:
+                    var._pending_write = True
+        with opr.lock:
+            opr.wait = pending
+            ready = opr.wait == 0
+        if ready:
+            self._dispatch(opr)
+        return opr
+
+    def _check_duplicate(self, const_vars, mutable_vars):
+        mset = set(id(v) for v in mutable_vars)
+        if len(mset) != len(mutable_vars):
+            raise MXNetError("duplicate mutable vars")
+        for v in const_vars:
+            if id(v) in mset:
+                raise MXNetError(
+                    "var appears in both const_vars and mutable_vars"
+                )
+
+    def _dispatch(self, opr):
+        self._pool.submit(self._execute, opr)
+
+    def _execute(self, opr):
+        try:
+            opr.fn()
+        finally:
+            self._on_complete(opr)
+
+    def _on_complete(self, opr):
+        """CompleteReadDependency/CompleteWriteDependency + trigger
+        successors (ThreadedEngine::OnComplete, threaded_engine.cc:351)."""
+        to_dispatch = []
+        for var in opr.const_vars:
+            with var._lock:
+                var._num_pending_reads -= 1
+                if var._num_pending_reads == 0:
+                    to_dispatch.extend(self._drain(var))
+        for var in opr.mutable_vars:
+            with var._lock:
+                var._pending_write = False
+                to_dispatch.extend(self._drain(var))
+        for nxt in to_dispatch:
+            with nxt.lock:
+                nxt.wait -= 1
+                ready = nxt.wait == 0
+            if ready:
+                self._dispatch(nxt)
+        opr.done.set()
+        with self._lock:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._all_done.notify_all()
+
+    def _drain(self, var):
+        """Pop newly-runnable ops off a var's queue (caller holds var lock)."""
+        out = []
+        while var._queue:
+            mode, opr = var._queue[0]
+            if mode == "r":
+                if var._pending_write:
+                    break
+                var._queue.popleft()
+                var._num_pending_reads += 1
+                out.append(opr)
+            else:
+                if var._pending_write or var._num_pending_reads:
+                    break
+                var._queue.popleft()
+                var._pending_write = True
+                out.append(opr)
+                break
+        return out
+
+    def wait_for_var(self, var):
+        done = threading.Event()
+        self.push(done.set, const_vars=[var])
+        done.wait()
+
+    def wait_for_all(self):
+        with self._lock:
+            while self._inflight:
+                self._all_done.wait()
+
+
+class NaiveEngine:
+    """Synchronous engine for debugging (naive_engine.cc:16)."""
+
+    def new_variable(self):
+        return Var()
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        fn()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_for_all(self):
+        pass
+
+
+_ENGINE = None
+
+
+def get():
+    """Engine singleton, type from MXNET_ENGINE_TYPE (engine.cc:13)."""
+    global _ENGINE
+    if _ENGINE is None:
+        etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+        if etype == "NaiveEngine":
+            _ENGINE = NaiveEngine()
+        else:
+            _ENGINE = ThreadedEngine()
+    return _ENGINE

@@ -1,0 +1,496 @@
+"""Executor: symbol → compiled XLA forward/backward.
+
+Parity: reference ``python/mxnet/executor.py`` + ``src/executor/``
+(GraphExecutor). This is THE seam SURVEY.md §3.2 identifies: everything the
+reference does in GraphExecutor::Init — gradient pass, placement,
+shape/type inference, memory planning, cached engine ops, bulk segments —
+is replaced by tracing the whole symbol into one JAX function and
+jit-compiling it:
+
+- InitFullGraph + nnvm Gradient pass  → jax.vjp over the traced forward
+- PlanMemory / InplaceAddTo           → XLA buffer assignment (+ donation)
+- InitCachedOps / bulk-exec segments  → a single fused XLA module per
+  (forward, forward+backward) — strictly stronger than the reference's
+  15-node bulk segments
+- AttachOpResources (temp space/rng)  → functional PRNG keys folded per-node
+
+The training step (forward+backward) compiles to ONE XLA executable, so
+per-op dispatch overhead — the reason the reference needs its threaded
+engine — is zero on the hot path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ndarray as nd
+from . import random as _random
+from .base import MXNetError, np_dtype
+from .context import Context
+from .ndarray import NDArray
+from .symbol import Symbol, _topo_order
+
+__all__ = ["Executor"]
+
+
+def _as_jax(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class _GraphProgram:
+    """A symbol lowered to a pure function of (args, aux, rng) — the unit
+    that gets jitted. Built once per bind; shared by fwd and fwd+bwd."""
+
+    def __init__(self, symbol: Symbol, shape_overrides=None):
+        self.symbol = symbol
+        # id(node) -> resolved out shape, for creation ops whose attr shape
+        # has unknown (0) dims (RNN begin_state zeros)
+        self.shape_overrides = shape_overrides or {}
+        self.nodes = _topo_order([n for n, _ in symbol._outputs])
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_entries = list(symbol._outputs)
+        self._var_nodes = {
+            n.name: n for n in self.nodes if n.is_variable
+        }
+        # stable per-node ids for rng folding
+        self._node_ids = {id(n): i for i, n in enumerate(self.nodes)}
+
+    def __call__(self, arg_values, aux_values, rng, is_train):
+        """arg_values: dict name→jax array; aux_values: dict name→jax array.
+        Returns (outputs list, new_aux dict)."""
+        import jax
+
+        env = {}
+        for name, v in arg_values.items():
+            node = self._var_nodes.get(name)
+            if node is not None:
+                env[(id(node), 0)] = v
+        for name, v in aux_values.items():
+            node = self._var_nodes.get(name)
+            if node is not None:
+                env[(id(node), 0)] = v
+        new_aux = {}
+        for node in self.nodes:
+            if node.is_variable:
+                if (id(node), 0) not in env:
+                    raise MXNetError("executor: missing input %s" % node.name)
+                continue
+            attrs = node.canon_attrs()
+            if id(node) in self.shape_overrides:
+                attrs["shape"] = self.shape_overrides[id(node)]
+            if node.op.needs_rng:
+                if rng is None:
+                    raise MXNetError("executor: rng required for %s" % node.name)
+                attrs["__rng__"] = jax.random.fold_in(rng, self._node_ids[id(node)])
+            in_vals = [env[(id(c), i)] for (c, i) in node.inputs]
+            results = node.op.fcompute(attrs, in_vals, is_train)
+            n_outs = node.num_outputs()
+            for i, v in enumerate(results[:n_outs]):
+                env[(id(node), i)] = v
+            # trailing results update this node's aux-state variables
+            n_args = node._extra.get("n_args", len(node.inputs))
+            aux_inputs = node.inputs[n_args:]
+            for (c, _), v in zip(aux_inputs, results[n_outs:]):
+                new_aux[c.name] = v
+        outputs = [env[(id(n), i)] for (n, i) in self.output_entries]
+        for name in self.aux_names:
+            if name not in new_aux:
+                new_aux[name] = aux_values[name]
+        return outputs, new_aux
+
+
+class _LazyOutputs:
+    """Sequence view over an executor's outputs that materializes the
+    deferred train-step forward on first access."""
+
+    def __init__(self, exe):
+        self._exe = exe
+
+    def __len__(self):
+        return len(self._exe.outputs)
+
+    def __getitem__(self, i):
+        return self._exe.outputs[i]
+
+    def __iter__(self):
+        return iter(self._exe.outputs)
+
+    def __repr__(self):
+        return repr(self._exe.outputs)
+
+
+class Executor:
+    """Bound computation: holds arg/grad/aux NDArrays + compiled step fns.
+
+    Parity: reference ``include/mxnet/executor.h`` —
+    Forward/Backward/outputs/arg_dict/grad_dict/aux_dict/reshape/
+    copy_params_from/set_monitor_callback.
+    """
+
+    def __init__(self, symbol, ctx, arg_arrays, grad_arrays, grad_req,
+                 aux_arrays, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        overrides = self._resolve_creation_shapes(symbol, arg_arrays)
+        self._program = _GraphProgram(symbol, overrides)
+        self.arg_arrays = list(arg_arrays)
+        self.grad_arrays = list(grad_arrays)
+        self.aux_arrays = list(aux_arrays)
+        self._arg_names = self._program.arg_names
+        self._aux_names = self._program.aux_names
+        self._output_names = symbol.list_outputs()
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = grad_req
+        # names we differentiate wrt (grad buffer attached + req != null)
+        self._grad_names = [
+            n
+            for n, g in zip(self._arg_names, self.grad_arrays)
+            if g is not None and self._grad_req.get(n, "null") != "null"
+        ]
+        self._outputs_list = [None] * len(self._output_names)
+        self._stash = None  # (arg_vals, aux_vals, rng) captured at forward()
+        self._needs_rng = any(
+            (not n.is_variable) and n.op.needs_rng for n in self._program.nodes
+        )
+        self._fwd_jit = self._make_fwd()
+        self._fwdbwd_jit = self._make_fwdbwd()
+        self._pending_train_step = False
+
+    @staticmethod
+    def _resolve_creation_shapes(symbol, arg_arrays):
+        """For creation ops (_zeros/_ones) with unknown dims in their shape
+        attr, resolve concrete shapes via graph-wide inference."""
+        nodes = _topo_order([n for n, _ in symbol._outputs])
+        pending = [
+            n for n in nodes
+            if (not n.is_variable)
+            and not n.inputs
+            and 0 in tuple(n.canon_attrs().get("shape") or ())
+        ]
+        if not pending:
+            return {}
+        arg_names = symbol.list_arguments()
+        shapes = {
+            n: a.shape for n, a in zip(arg_names, arg_arrays) if a is not None
+        }
+        env = symbol._infer_shape_env(**shapes)
+        return {id(n): env[(id(n), 0)] for n in pending if (id(n), 0) in env}
+
+    # ------------------------------------------------------------------
+    # compiled callables
+    # ------------------------------------------------------------------
+    def _make_fwd(self):
+        program = self._program
+        arg_names = tuple(self._arg_names)
+        aux_names = tuple(self._aux_names)
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def fwd(arg_vals, aux_vals, rng, is_train):
+            args = dict(zip(arg_names, arg_vals))
+            aux = dict(zip(aux_names, aux_vals))
+            outs, new_aux = program(args, aux, rng, is_train)
+            return tuple(outs), tuple(new_aux[n] for n in aux_names)
+
+        return fwd
+
+    def _make_fwdbwd(self):
+        program = self._program
+        arg_names = tuple(self._arg_names)
+        aux_names = tuple(self._aux_names)
+        grad_names = tuple(self._grad_names)
+
+        @jax.jit
+        def fwdbwd(arg_vals, aux_vals, rng, out_grads):
+            args = dict(zip(arg_names, arg_vals))
+            aux = dict(zip(aux_names, aux_vals))
+            fixed = {k: v for k, v in args.items() if k not in grad_names}
+
+            def f(diff_vals):
+                a = dict(fixed)
+                a.update(dict(zip(grad_names, diff_vals)))
+                outs, new_aux = program(a, aux, rng, True)
+                return tuple(outs), tuple(new_aux[n] for n in aux_names)
+
+            diff_vals = tuple(args[n] for n in grad_names)
+            (outs, new_aux), vjp_fn = jax.vjp(f, diff_vals)
+            if out_grads is None:
+                cts = tuple(jnp.ones_like(o) for o in outs)
+            else:
+                cts = tuple(out_grads)
+            zero_aux_ct = tuple(jnp.zeros_like(a) for a in new_aux)
+            (grads,) = vjp_fn((cts, zero_aux_ct))
+            return outs, new_aux, grads
+
+        return fwdbwd
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Parity: Executor::Forward. For a training step the launch is
+        deferred so backward() can run forward+backward as ONE fused XLA
+        executable (the whole-graph analog of the reference's bulk-exec
+        segments); reading .outputs before backward() materializes a
+        forward-only run from the same stashed inputs + rng, so results are
+        bit-identical either way."""
+        if kwargs:
+            arg_dict = self.arg_dict
+            for k, v in kwargs.items():
+                if k not in arg_dict:
+                    raise MXNetError("unknown input %s" % k)
+                if isinstance(v, NDArray):
+                    arg_dict[k]._data = v._data
+                else:
+                    arg_dict[k]._data = nd.array(v)._data
+        rng = _random.next_key() if self._needs_rng else None
+        arg_vals = tuple(a._data for a in self.arg_arrays)
+        aux_vals = tuple(a._data for a in self.aux_arrays)
+        self._stash = (arg_vals, aux_vals, rng, bool(is_train))
+        if is_train and self._grad_names:
+            self._pending_train_step = True
+            # lazy view: materializes via the outputs property on first
+            # element access, so callers using forward()'s return value get
+            # fresh data while the fit loop (which ignores it) keeps the
+            # single fused fwd+bwd launch.
+            return _LazyOutputs(self)
+        self._pending_train_step = False
+        outs, new_aux = self._fwd_jit(arg_vals, aux_vals, rng, bool(is_train))
+        self._set_outputs(outs)
+        if is_train:
+            for a, v in zip(self.aux_arrays, new_aux):
+                a._data = v
+        self._run_monitor()
+        return self.outputs
+
+    @property
+    def outputs(self):
+        if self._pending_train_step:
+            arg_vals, aux_vals, rng, _ = self._stash
+            outs, new_aux = self._fwd_jit(arg_vals, aux_vals, rng, True)
+            self._set_outputs(outs)
+            # moving-stat aux updates happen on forward in the reference
+            # (FMutateInputs); backward recomputes the same values from the
+            # stashed aux so there is no double-apply.
+            for a, v in zip(self.aux_arrays, new_aux):
+                a._data = v
+            self._pending_train_step = False
+        return self._outputs_list
+
+    def _set_outputs(self, outs):
+        for i, v in enumerate(outs):
+            if self._outputs_list[i] is None:
+                self._outputs_list[i] = NDArray(v)
+            else:
+                self._outputs_list[i]._data = v
+        return self._outputs_list
+
+    def backward(self, out_grads=None):
+        """Run the fused forward+backward XLA step and write gradients into
+        grad_arrays honoring grad_req (write/add/null). Parity:
+        Executor::Backward; grad_req semantics = kWriteTo/kAddTo/kNullOp."""
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            out_grads = tuple(_as_jax(g) for g in out_grads)
+        if not self._grad_names:
+            return
+        if self._stash is not None:
+            arg_vals, aux_vals, rng, _ = self._stash
+        else:
+            arg_vals = tuple(a._data for a in self.arg_arrays)
+            aux_vals = tuple(a._data for a in self.aux_arrays)
+            rng = _random.next_key() if self._needs_rng else None
+        outs, new_aux, grads = self._fwdbwd_jit(arg_vals, aux_vals, rng, out_grads)
+        self._pending_train_step = False
+        self._set_outputs(outs)
+        for a, v in zip(self.aux_arrays, new_aux):
+            a._data = v
+        gmap = dict(zip(self._grad_names, grads))
+        for name, garr in zip(self._arg_names, self.grad_arrays):
+            if garr is None or name not in gmap:
+                continue
+            req = self._grad_req.get(name, "write")
+            if req == "add":
+                garr._data = garr._data + gmap[name]
+            elif req == "write":
+                garr._data = gmap[name]
+        self._run_monitor()
+
+    # ------------------------------------------------------------------
+    # dict views (parity executor.py:248-298)
+    # ------------------------------------------------------------------
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        arg_dict = self.arg_dict
+        for name, array in arg_params.items():
+            if name in arg_dict:
+                array.copyto(arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" not in executor arguments" % name)
+        if aux_params is not None:
+            aux_dict = self.aux_dict
+            for name, array in aux_params.items():
+                if name in aux_dict:
+                    array.copyto(aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("Found name \"%s\" not in executor aux states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new input shapes, sharing parameter
+        arrays (parity executor.py:360; the reference shares memory — XLA
+        owns buffers here so we share the NDArray handles)."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = []
+        new_grads = []
+        for name, arr, garr, shp in zip(
+            self._arg_names, self.arg_arrays, self.grad_arrays, arg_shapes
+        ):
+            if name in kwargs or tuple(arr.shape) != tuple(shp):
+                new_args.append(nd.zeros(shp, ctx=self._ctx, dtype=arr.dtype))
+                new_grads.append(
+                    None if garr is None else nd.zeros(shp, ctx=self._ctx, dtype=arr.dtype)
+                )
+            else:
+                new_args.append(arr)
+                new_grads.append(garr)
+        new_aux = []
+        for arr, shp in zip(self.aux_arrays, aux_shapes):
+            if tuple(arr.shape) != tuple(shp):
+                new_aux.append(nd.zeros(shp, ctx=self._ctx, dtype=arr.dtype))
+            else:
+                new_aux.append(arr)
+        return Executor(
+            self._symbol, self._ctx, new_args, new_grads, self._grad_req,
+            new_aux, self._group2ctx
+        )
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def _run_monitor(self):
+        if self._monitor_callback is None:
+            return
+        for name, out in zip(self._output_names, self.outputs):
+            if out is not None:
+                self._monitor_callback(name, out)
+
+    def debug_str(self):
+        return self._symbol.debug_str()
+
+    # ------------------------------------------------------------------
+    # binding entry points
+    # ------------------------------------------------------------------
+    @staticmethod
+    def bind(symbol, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        if not isinstance(ctx, Context):
+            ctx = Context(ctx)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_arrays = _check_arguments(args, arg_names, "args")
+        if args_grad is None:
+            grad_arrays = [None] * len(arg_names)
+        elif isinstance(args_grad, dict):
+            grad_arrays = [args_grad.get(n) for n in arg_names]
+        else:
+            grad_arrays = list(args_grad)
+            grad_arrays += [None] * (len(arg_names) - len(grad_arrays))
+        if aux_states is None:
+            aux_arrays = []
+            if aux_names:
+                _, _, aux_shapes = symbol.infer_shape(
+                    **{n: a.shape for n, a in zip(arg_names, arg_arrays)}
+                )
+                aux_arrays = [nd.zeros(s, ctx=ctx) for s in aux_shapes]
+        elif isinstance(aux_states, dict):
+            aux_arrays = [aux_states[n] for n in aux_names]
+        else:
+            aux_arrays = list(aux_states)
+        return Executor(
+            symbol, ctx, arg_arrays, grad_arrays, grad_req, aux_arrays, group2ctx
+        )
+
+    @staticmethod
+    def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        """Infer shapes/types, allocate arg/grad/aux arrays, bind.
+        Parity: symbol.py:1114."""
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        if not isinstance(ctx, Context):
+            ctx = Context(ctx)
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
+        arg_types, _, aux_types = symbol.infer_type(**(type_dict or {}))
+        arg_names = symbol.list_arguments()
+        # share param arrays with shared_exec when shapes match (bucketing)
+        shared = shared_exec.arg_dict if shared_exec is not None else {}
+        arg_arrays = []
+        for name, shape, dtype in zip(arg_names, arg_shapes, arg_types):
+            if name in shared and tuple(shared[name].shape) == tuple(shape):
+                arg_arrays.append(shared[name])
+            else:
+                arg_arrays.append(nd.zeros(shape, ctx=ctx, dtype=dtype))
+        req_of = (
+            (lambda n: grad_req)
+            if isinstance(grad_req, str)
+            else (lambda n: grad_req.get(n, "null"))
+            if isinstance(grad_req, dict)
+            else (lambda n: dict(zip(arg_names, grad_req)).get(n, "null"))
+        )
+        grad_arrays = [
+            nd.zeros(shape, ctx=ctx, dtype=dtype) if req_of(name) != "null" else None
+            for name, shape, dtype in zip(arg_names, arg_shapes, arg_types)
+        ]
+        shared_aux = shared_exec.aux_dict if shared_exec is not None else {}
+        aux_names = symbol.list_auxiliary_states()
+        aux_arrays = []
+        for name, shape, dtype in zip(aux_names, aux_shapes, aux_types):
+            if name in shared_aux and tuple(shared_aux[name].shape) == tuple(shape):
+                aux_arrays.append(shared_aux[name])
+            else:
+                aux_arrays.append(nd.zeros(shape, ctx=ctx, dtype=dtype))
+        return Executor(
+            symbol, ctx, arg_arrays, grad_arrays, grad_req, aux_arrays, group2ctx
+        )
+
+
+def _check_arguments(args, names, kind):
+    if isinstance(args, dict):
+        out = []
+        for n in names:
+            if n not in args:
+                raise MXNetError("missing %s: %s" % (kind, n))
+            out.append(args[n])
+        return out
+    args = list(args)
+    if len(args) != len(names):
+        raise MXNetError(
+            "%s length %d != expected %d (%s)" % (kind, len(args), len(names), names)
+        )
+    return args

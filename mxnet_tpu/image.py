@@ -1,0 +1,451 @@
+"""Image pipeline (pure-python/NDArray-op).
+
+Parity: reference ``python/mxnet/image.py`` (imdecode, augmenter closures,
+CreateAugmenter, ImageIter reading .rec or .lst) and, via
+``from_recordio_params``, the C++ ImageRecordIter parameter surface
+(``src/io/iter_image_recordio_2.cc:559``). Decode/augment runs on host
+worker threads (the reference's OMP decode pool,
+iter_image_recordio_2.cc:103) feeding asynchronous device puts.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue as _queue
+import random
+import threading
+
+import numpy as np
+
+from . import ndarray as nd
+from . import io as mxio
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from . import recordio
+
+
+def imdecode(buf, **kwargs):
+    """Decode an image byte buffer to an NDArray (HWC, RGB)."""
+    arr = recordio._imdecode_np(
+        buf if isinstance(buf, bytes) else bytes(buf),
+        kwargs.get("flag", 1),
+    )
+    return nd.array(arr.astype(np.float32))
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def imresize(src, w, h, interp=2):
+    import jax.image
+
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = np.asarray(
+        jax.image.resize(arr, (h, w) + arr.shape[2:], method="bilinear")
+    )
+    return nd.array(out)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0 : y0 + h, x0 : x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp=interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3.0 / 4.0, 4.0 / 3.0),
+                     interp=2):
+    h, w = src.shape[0], src.shape[1]
+    area = w * h
+    for _ in range(10):
+        new_area = random.uniform(min_area, 1.0) * area
+        new_ratio = random.uniform(*ratio)
+        new_w = int(np.sqrt(new_area * new_ratio))
+        new_h = int(np.sqrt(new_area / new_ratio))
+        if random.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def ResizeAug(size, interp=2):
+    def aug(src):
+        return [resize_short(src, size, interp)]
+
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomOrderAug(ts):
+    def aug(src):
+        srcs = [src]
+        random.shuffle(ts)
+        for t in ts:
+            srcs = sum([t(s) for s in srcs], [])
+        return srcs
+
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    ts = []
+    coef = nd.array(np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32))
+    if brightness > 0:
+
+        def baug(src):
+            alpha = 1.0 + random.uniform(-brightness, brightness)
+            return [src * alpha]
+
+        ts.append(baug)
+    if contrast > 0:
+
+        def caug(src):
+            alpha = 1.0 + random.uniform(-contrast, contrast)
+            gray = src * coef
+            gray = (3.0 * (1.0 - alpha) / gray.size) * nd.sum(gray)
+            return [src * alpha + gray]
+
+        ts.append(caug)
+    if saturation > 0:
+
+        def saug(src):
+            alpha = 1.0 + random.uniform(-saturation, saturation)
+            gray = src * coef
+            gray = nd.sum(gray, axis=2, keepdims=True)
+            return [src * alpha + gray * (1.0 - alpha)]
+
+        ts.append(saug)
+    return RandomOrderAug(ts)
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(eigvec * alpha, eigval)
+        return [src + nd.array(rgb)]
+
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    mean_nd = nd.array(mean) if not isinstance(mean, nd.NDArray) else mean
+    std_nd = nd.array(std) if std is not None and not isinstance(std, nd.NDArray) else std
+
+    def aug(src):
+        return [color_normalize(src, mean_nd, std_nd)]
+
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if random.random() < p:
+            return [nd.flip(src, axis=(1,))]
+        return [src]
+
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [src.astype(np.float32)]
+
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Parity image.py:351."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(
+            RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0), inter_method)
+        )
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array(
+            [
+                [-0.5675, 0.7192, 0.4009],
+                [-0.5808, -0.0045, -0.8140],
+                [-0.5836, -0.6948, 0.4203],
+            ]
+        )
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        assert isinstance(mean, np.ndarray) and mean.shape[0] in [1, 3]
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        assert isinstance(std, np.ndarray) and std.shape[0] in [1, 3]
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over .rec (RecordIO) or .lst+images.
+
+    Parity: image.py:400 + the C++ ImageRecordIter capability. Decoding and
+    augmentation run on `preprocess_threads` host workers; batches are
+    assembled NCHW float32.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", preprocess_threads=4, **kwargs):
+        super().__init__()
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        if path_imgrec:
+            logging.info("loading recordio %s...", path_imgrec)
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r"
+                )
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+        if path_imglist:
+            logging.info("loading image list %s...", path_imglist)
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]])
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+        elif isinstance(imglist, list):
+            logging.info("loading image list...")
+            result = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                if isinstance(img[0], (list, np.ndarray)):
+                    label = np.array(img[0])
+                else:
+                    label = np.array([img[0]])
+                result[key] = (label, img[1])
+                imgkeys.append(str(key))
+            self.imglist = result
+        else:
+            self.imglist = None
+        self.path_root = path_root
+
+        self.check_data_shape(data_shape)
+        self.provide_data = [DataDesc(data_name, (batch_size,) + data_shape)]
+        if label_width > 1:
+            self.provide_label = [
+                DataDesc(label_name, (batch_size, label_width))
+            ]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if self.imgrec is None:
+            self.seq = imgkeys
+        elif shuffle or num_parts > 1:
+            assert self.imgidx is not None, (
+                "shuffling/partition requires a .idx file"
+            )
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            N = len(self.seq)
+            C = N // num_parts
+            self.seq = self.seq[part_index * C : (part_index + 1) * C]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast",
+                         "saturation", "pca_noise", "inter_method")
+            })
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @classmethod
+    def from_recordio_params(cls, path_imgrec, data_shape, batch_size,
+                             mean_r=0.0, mean_g=0.0, mean_b=0.0, scale=1.0,
+                             rand_crop=False, rand_mirror=False, shuffle=False,
+                             preprocess_threads=4, path_imgidx=None,
+                             label_width=1, **kwargs):
+        """Adapter giving the C++ ImageRecordIter's param names
+        (iter_image_recordio_2.cc param struct)."""
+        mean = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b])
+        aug = CreateAugmenter(
+            data_shape, rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean
+        )
+        if scale != 1.0:
+            aug.append(lambda src: [src * scale])
+        return cls(
+            batch_size, tuple(data_shape), label_width=label_width,
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
+            aug_list=aug, preprocess_threads=preprocess_threads,
+        )
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
+        batch_label = np.zeros(
+            (batch_size,) if self.label_width == 1 else (batch_size, self.label_width),
+            dtype=np.float32,
+        )
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = [imdecode(s) if isinstance(s, (bytes, bytearray)) else nd.array(np.asarray(s, np.float32))]
+                if data[0].shape[0] == 0:
+                    logging.debug("Invalid image, skipping.")
+                    continue
+                for aug in self.auglist:
+                    data = [ret for src in data for ret in aug(src)]
+                for d in data:
+                    assert i < batch_size, "Batch size must be multiple of augmenter output length"
+                    batch_data[i] = d.asnumpy()
+                    batch_label[i] = label
+                    i += 1
+        except StopIteration:
+            if not i:
+                raise StopIteration
+        # NHWC → NCHW
+        batch_nchw = np.transpose(batch_data, (0, 3, 1, 2))
+        return DataBatch(
+            [nd.array(batch_nchw)], [nd.array(batch_label)], batch_size - i
+        )
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError("data_shape should have length 3, with dimensions CxHxW")
+        if not data_shape[0] == 3 and not data_shape[0] == 1:
+            raise ValueError("This iterator expects inputs to have 1 or 3 channels.")
+
+    def read_image(self, fname):
+        with open(os.path.join(self.path_root or "", fname), "rb") as fin:
+            return fin.read()

@@ -1,0 +1,16 @@
+"""Model zoo: symbol builders for the reference's headline workloads.
+
+Capability parity targets (SURVEY.md §7 / BASELINE.md): MLP + LeNet
+(MNIST), ResNet-18/34/50/101/152 + ResNeXt, Inception-v3/BN, AlexNet,
+VGG (ImageNet), LSTM language models (PTB), and a transformer with ring
+attention (the TPU-native long-context flagship — beyond reference
+parity, standing in for its model-parallel LSTM).
+"""
+from .mlp import get_symbol as mlp
+from .lenet import get_symbol as lenet
+from .alexnet import get_symbol as alexnet
+from .resnet import get_symbol as resnet
+from .inception_v3 import get_symbol as inception_v3
+from .vgg import get_symbol as vgg
+from .lstm import lstm_unroll, BucketingLSTMModel
+from .transformer import transformer_lm

@@ -1,0 +1,68 @@
+"""LSTM language model (PTB) — bucketing workload.
+
+Capability parity: reference example/rnn/lstm_bucketing.py +
+cudnn_lstm_bucketing.py (SURVEY.md §7 workload 3). Two paths, matching the
+reference:
+- ``lstm_unroll``: explicitly unrolled LSTMCell stack (the nnvm-graph path)
+- ``fused_lstm_sym``: FusedRNNCell → the ``RNN`` op (lax.scan kernel)
+"""
+from .. import symbol as sym
+from ..rnn.rnn_cell import FusedRNNCell, LSTMCell, SequentialRNNCell
+
+
+def lstm_unroll(num_layers, seq_len, input_size, num_hidden, num_embed,
+                num_label, dropout=0.0):
+    """Unrolled symbol for one bucket length (sym_gen inner)."""
+    stack = SequentialRNNCell()
+    for i in range(num_layers):
+        stack.add(LSTMCell(num_hidden=num_hidden, prefix="lstm_l%d_" % i))
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data, input_dim=input_size, output_dim=num_embed,
+                          name="embed")
+    stack.reset()
+    outputs, states = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+    pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = sym.FullyConnected(pred, num_hidden=num_label, name="pred")
+    label_flat = sym.Reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(pred, label_flat, name="softmax")
+
+
+def fused_lstm_sym(num_layers, seq_len, input_size, num_hidden, num_embed,
+                   num_label, dropout=0.0):
+    """FusedRNNCell path (parity cudnn_lstm_bucketing.py)."""
+    cell = FusedRNNCell(num_hidden, num_layers=num_layers, mode="lstm",
+                        dropout=dropout)
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data, input_dim=input_size, output_dim=num_embed,
+                          name="embed")
+    outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True,
+                             layout="NTC")
+    pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = sym.FullyConnected(pred, num_hidden=num_label, name="pred")
+    label_flat = sym.Reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(pred, label_flat, name="softmax"), cell
+
+
+class BucketingLSTMModel:
+    """sym_gen factory for BucketingModule (parity lstm_bucketing.py:69)."""
+
+    def __init__(self, num_layers, input_size, num_hidden, num_embed,
+                 num_label, dropout=0.0, fused=False):
+        self.num_layers = num_layers
+        self.input_size = input_size
+        self.num_hidden = num_hidden
+        self.num_embed = num_embed
+        self.num_label = num_label
+        self.dropout = dropout
+        self.fused = fused
+
+    def __call__(self, bucket_key):
+        builder = fused_lstm_sym if self.fused else lstm_unroll
+        out = builder(
+            self.num_layers, bucket_key, self.input_size, self.num_hidden,
+            self.num_embed, self.num_label, self.dropout
+        )
+        symf = out[0] if isinstance(out, tuple) else out
+        return symf, ("data",), ("softmax_label",)

@@ -1,0 +1,94 @@
+"""Transformer LM with ring attention — the TPU-native long-context model.
+
+The reference's long-sequence story is bucketing + model-parallel LSTM
+(SURVEY.md §5.7); the idiomatic TPU equivalent is a transformer whose
+sequence axis shards over the mesh 'sp' axis with ring attention
+(mxnet_tpu.parallel.ring_attention) and whose FFN/attention projections
+shard over 'tp'. This is a pure-JAX model (not the Symbol API): it is the
+flagship for the multi-chip dryrun and the long-context benchmark.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def transformer_lm(vocab=32000, d_model=512, n_heads=8, n_layers=4,
+                   d_ff=2048, dtype=None):
+    """Returns (init_fn(rng, seq_len, batch) -> params,
+                apply_fn(params, tokens, mesh=None) -> logits)."""
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.bfloat16
+    head_dim = d_model // n_heads
+
+    def init_fn(seed=0):
+        rng = np.random.RandomState(seed)
+
+        def w(*shape, scale=None):
+            scale = scale or (1.0 / np.sqrt(shape[0]))
+            return (rng.randn(*shape) * scale).astype(np.float32)
+
+        params = {"embed": w(vocab, d_model, scale=0.02)}
+        for i in range(n_layers):
+            params["l%d" % i] = {
+                "ln1": np.ones((d_model,), np.float32),
+                "ln2": np.ones((d_model,), np.float32),
+                "wq": w(d_model, n_heads * head_dim),
+                "wk": w(d_model, n_heads * head_dim),
+                "wv": w(d_model, n_heads * head_dim),
+                "wo": w(n_heads * head_dim, d_model),
+                "w1": w(d_model, d_ff),
+                "w2": w(d_ff, d_model),
+            }
+        params["ln_f"] = np.ones((d_model,), np.float32)
+        return params
+
+    def rmsnorm(x, g):
+        x32 = x.astype(jnp.float32)
+        n = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + 1e-6)
+        return (n * g).astype(x.dtype)
+
+    def attention(x, p, mesh=None):
+        B, T, D = x.shape
+        q = (x @ p["wq"].astype(dtype)).reshape(B, T, n_heads, head_dim)
+        k = (x @ p["wk"].astype(dtype)).reshape(B, T, n_heads, head_dim)
+        v = (x @ p["wv"].astype(dtype)).reshape(B, T, n_heads, head_dim)
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            from ..parallel.ring_attention import sequence_parallel_attention
+
+            o = sequence_parallel_attention(q, k, v, mesh, causal=True)
+        else:
+            scale = 1.0 / np.sqrt(head_dim)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+            a = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", a, v)
+        return o.reshape(B, T, D) @ p["wo"].astype(dtype)
+
+    def apply_fn(params, tokens, mesh=None):
+        x = jnp.take(params["embed"], tokens, axis=0).astype(dtype)
+        # simple learned-free positional encoding (rotary-lite: sinusoidal)
+        T = tokens.shape[1]
+        pos = np.arange(100000)[:, None] / (
+            10000 ** (np.arange(0, d_model, 2) / d_model)
+        )
+        pe = jnp.asarray(
+            np.concatenate([np.sin(pos), np.cos(pos)], axis=-1)[:T], dtype
+        )
+        x = x + pe[None]
+        for i in range(n_layers):
+            p = params["l%d" % i]
+            x = x + attention(rmsnorm(x, p["ln1"].astype(dtype)), p, mesh)
+            h = rmsnorm(x, p["ln2"].astype(dtype))
+            h = jax.nn.gelu(h @ p["w1"].astype(dtype))
+            x = x + h @ p["w2"].astype(dtype)
+        x = rmsnorm(x, params["ln_f"].astype(dtype))
+        logits = x.astype(jnp.float32) @ params["embed"].T
+        return logits
+
+    return init_fn, apply_fn

@@ -1,0 +1,12 @@
+"""Module API — the primary training stack.
+
+Parity: reference ``python/mxnet/module/`` (BaseModule/Module/
+BucketingModule/SequentialModule/PythonModule; the executor-group data
+parallelism of §3.1).
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule
+from .executor_group import DataParallelExecutorGroup

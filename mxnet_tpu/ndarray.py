@@ -1,0 +1,612 @@
+"""NDArray: the imperative tensor API.
+
+Parity: reference ``python/mxnet/ndarray.py`` + ``src/ndarray/ndarray.cc``
++ ``include/mxnet/ndarray.h``. Design mapping (SURVEY.md §7 table):
+
+- The reference NDArray is a Chunk (storage handle + engine var); every op
+  is an engine push and ``WaitToRead`` is the sync point. Here an NDArray
+  wraps a ``jax.Array`` — XLA's async dispatch IS the dependency engine
+  (data dependencies are tracked by value), ``wait_to_read`` ≈
+  ``block_until_ready``.
+- ``MXImperativeInvoke`` (reference src/c_api/c_api_ndarray.cc:322 →
+  PushFCompute) becomes :func:`imperative_invoke`: one jit-compiled,
+  cache-keyed-by-(op, attrs, shapes, dtypes) callable per op instance, so
+  steady-state imperative dispatch is a cache hit + async XLA launch.
+- In-place mutation (``+=``, ``a[:]=``, out=) rebinds the handle's
+  underlying value — the buffer-versioning layer SURVEY.md §7 calls for.
+"""
+from __future__ import annotations
+
+import functools
+import struct
+import sys
+
+import numpy as np
+
+from . import autograd as _autograd
+from . import random as _random
+from .base import MXNetError, mx_dtype_code, np_dtype, dtype_name
+from .context import Context, current_context
+from .ops import registry as _registry
+
+__all__ = ["NDArray", "zeros", "ones", "array", "empty", "full", "arange",
+           "concatenate", "load", "save", "imperative_invoke", "waitall"]
+
+# op-namespace generation below shadows some builtins at module scope
+# (slice, sum, abs, ...); capture the ones methods need.
+_py_slice = slice
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _ctx_of_jax_device(dev):
+    plat = dev.platform
+    if plat == "cpu":
+        return Context("cpu", dev.id)
+    if plat in ("tpu", "axon"):
+        return Context("tpu", dev.id)
+    return Context("gpu", dev.id)
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_op(op_name, attr_key, is_train, with_rng):
+    """One jitted callable per (op, static attrs, mode). The returned fn
+    takes (rng_or_None, *arrays) and returns a tuple of arrays."""
+    jax = _jax()
+    opdef = _registry.get(op_name)
+
+    def run(rng, *arrays):
+        attrs = dict(attr_key)
+        if with_rng:
+            attrs["__rng__"] = rng
+        out = opdef.fcompute(attrs, list(arrays), is_train)
+        return tuple(out)
+
+    return jax.jit(run)
+
+
+def imperative_invoke(opdef, inputs, attrs, out=None):
+    """Invoke an operator imperatively on NDArrays.
+
+    Parity: MXImperativeInvoke (c_api_ndarray.cc:322): shape/type inference
+    is implicit (abstract-eval inside jit tracing), the engine push is jax's
+    async dispatch, and autograd recording hooks in exactly where
+    RecordImperativeFCompute does (c_api_ndarray.cc:375).
+    """
+    if isinstance(opdef, str):
+        opdef = _registry.get(opdef)
+    attrs = opdef.canon_attrs(attrs)
+    is_train = _autograd.is_training()
+    attr_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    rng = _random.next_key() if opdef.needs_rng else None
+    arrays = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            arrays.append(x._data)
+        else:
+            arrays.append(np.asarray(x))
+    fn = _compiled_op(opdef.name, attr_key, is_train, opdef.needs_rng)
+    results = fn(rng, *arrays)
+    # Trailing results map to reference-mutated inputs: explicit
+    # mutate_inputs (sgd_mom_update's momentum) or aux states (BatchNorm's
+    # moving_mean/var, which the reference mutates via FMutateInputs).
+    n_aux = len(opdef.list_auxiliary_states(attrs))
+    n_args = opdef.num_inputs(attrs)
+    n_writeback = len(opdef.mutate_inputs) + n_aux
+    n_out = len(results) - n_writeback
+    outs = results[:n_out]
+    writeback_idx = list(opdef.mutate_inputs) + list(
+        range(n_args, n_args + n_aux)
+    )
+    for idx, val in zip(writeback_idx, results[n_out:]):
+        if idx < len(inputs) and isinstance(inputs[idx], NDArray):
+            inputs[idx]._data = val
+
+    if out is not None:
+        out_list = [out] if isinstance(out, NDArray) else list(out)
+        for o, v in zip(out_list, outs):
+            o._data = v
+        ret = out_list[0] if len(out_list) == 1 else out_list
+    else:
+        out_list = [NDArray(v) for v in outs]
+        ret = out_list[0] if len(out_list) == 1 else out_list
+
+    if _autograd.is_recording():
+        # record ALL inputs positionally; non-NDArray inputs keep their
+        # converted array value so backward replay sees the same arity
+        recorded = [
+            x if isinstance(x, NDArray) else a
+            for x, a in zip(inputs, arrays)
+        ]
+        _autograd.record_op(
+            opdef,
+            dict(attr_key) | ({"__rng__": rng} if rng is not None else {}),
+            recorded,
+            out_list,
+        )
+    return ret
+
+
+class NDArray:
+    """An n-dimensional array on a device, with async-op semantics."""
+
+    __slots__ = ("_data",)
+    # prefer our operators over numpy's in mixed expressions
+    __array_priority__ = 1000.0
+
+    def __init__(self, data):
+        self._data = data
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return np_dtype(self._data.dtype)
+
+    @property
+    def context(self):
+        jax = _jax()
+        dev = self._data.device
+        if hasattr(dev, "platform"):
+            return _ctx_of_jax_device(dev)
+        devs = list(self._data.devices())
+        return _ctx_of_jax_device(devs[0])
+
+    ctx = context
+
+    @property
+    def T(self):
+        return imperative_invoke("transpose", [self], {})
+
+    # -- sync ---------------------------------------------------------------
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    # -- conversion / movement ---------------------------------------------
+    def astype(self, dtype):
+        return NDArray(self._data.astype(np_dtype(dtype)))
+
+    def copyto(self, other):
+        jax = _jax()
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            other._data = jax.device_put(self._data, other._data.device)
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device))
+        raise MXNetError("copyto: unsupported target %r" % (other,))
+
+    def copy(self):
+        return NDArray(self._data + 0)
+
+    def as_in_context(self, context):
+        if self.context == context:
+            return self
+        return self.copyto(context)
+
+    # -- shape manipulation -------------------------------------------------
+    def reshape(self, shape):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return imperative_invoke("Reshape", [self], {"shape": tuple(shape)})
+
+    def broadcast_to(self, shape):
+        return imperative_invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return NDArray(self._data[key])
+        if isinstance(key, _py_slice):
+            if key.step is not None and key.step != 1:
+                raise MXNetError("NDArray only supports step=1 slicing")
+            return NDArray(self._data[key])
+        if isinstance(key, tuple):
+            return NDArray(self._data[key])
+        if isinstance(key, NDArray):
+            return NDArray(self._data[key._data.astype("int32")])
+        raise MXNetError("unsupported index %r" % (key,))
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            v = value._data
+        else:
+            v = value
+        if isinstance(key, _py_slice) and key.start is None and key.stop is None:
+            if np.isscalar(v):
+                self._data = jnp.full_like(self._data, v)
+            else:
+                self._data = jnp.broadcast_to(
+                    jnp.asarray(v, dtype=self.dtype), self.shape
+                ) + jnp.zeros_like(self._data)
+            return
+        self._data = self._data.at[key].set(v)
+
+    def slice(self, start, stop):
+        return NDArray(self._data[start:stop])
+
+    def at(self, idx):
+        return NDArray(self._data[idx])
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            if a.shape == b.shape:
+                return imperative_invoke(op, [a, b], {})
+            return imperative_invoke("broadcast_" + _BCAST_NAME[op], [a, b], {})
+        if np.isscalar(other):
+            name = ("_r" + scalar_op[1:]) if reverse and op in _NONCOMMUTATIVE else scalar_op
+            return imperative_invoke(name, [self], {"scalar": float(other)})
+        if isinstance(other, np.ndarray):
+            return self._binop(array(other, ctx=self.context, dtype=self.dtype), op, scalar_op, reverse)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __div__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar")
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar", reverse=True)
+
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, o):
+        return self._binop(o, "_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binop(o, "_power", "_power_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binop(o, "_mod", "_mod_scalar", reverse=True)
+
+    def __neg__(self):
+        return imperative_invoke("negative", [self], {})
+
+    def __eq__(self, o):
+        if isinstance(o, (NDArray, int, float, np.ndarray)):
+            return self._binop(o, "_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (NDArray, int, float, np.ndarray)):
+            return self._binop(o, "_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, o):
+        return self._binop(o, "_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    def __iadd__(self, o):
+        r = self.__add__(o)
+        self._data = r._data
+        return self
+
+    def __isub__(self, o):
+        r = self.__sub__(o)
+        self._data = r._data
+        return self
+
+    def __imul__(self, o):
+        r = self.__mul__(o)
+        self._data = r._data
+        return self
+
+    def __idiv__(self, o):
+        r = self.__div__(o)
+        self._data = r._data
+        return self
+
+    __itruediv__ = __idiv__
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __repr__(self):
+        return "<NDArray %s @%s>" % ("x".join(map(str, self.shape)), self.context)
+
+    def __getstate__(self):
+        return {"data": self.asnumpy()}
+
+    def __setstate__(self, state):
+        import jax.numpy as jnp
+
+        self._data = jnp.asarray(state["data"])
+
+
+_BCAST_NAME = {
+    "elemwise_add": "add",
+    "elemwise_sub": "sub",
+    "elemwise_mul": "mul",
+    "elemwise_div": "div",
+    "_power": "power",
+    "_mod": "mod",
+    "_equal": "equal",
+    "_not_equal": "not_equal",
+    "_greater": "greater",
+    "_greater_equal": "greater_equal",
+    "_lesser": "lesser",
+    "_lesser_equal": "lesser_equal",
+}
+_NONCOMMUTATIVE = {"elemwise_sub", "elemwise_div", "_power", "_mod"}
+
+
+# --------------------------------------------------------------------------
+# creation API
+# --------------------------------------------------------------------------
+def _put(arr, ctx):
+    jax = _jax()
+    ctx = ctx or current_context()
+    return jax.device_put(arr, ctx.jax_device)
+
+
+def empty(shape, ctx=None, dtype=np.float32):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=np.float32):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_put(np.zeros(shape, np_dtype(dtype)), ctx))
+
+
+def ones(shape, ctx=None, dtype=np.float32):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_put(np.ones(shape, np_dtype(dtype)), ctx))
+
+
+def full(shape, val, ctx=None, dtype=np.float32):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_put(np.full(shape, val, np_dtype(dtype)), ctx))
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        arr = source_array.asnumpy()
+    else:
+        arr = np.asarray(source_array)
+    if dtype is None:
+        dtype = arr.dtype if arr.dtype != np.float64 else np.float32
+    return NDArray(_put(arr.astype(np_dtype(dtype)), ctx))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=np.float32):
+    if stop is None:
+        start, stop = 0, start
+    out = np.arange(start, stop, step)
+    if repeat > 1:
+        out = np.repeat(out, repeat)
+    return NDArray(_put(out.astype(np_dtype(dtype)), ctx))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis))
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    return imperative_invoke("one_hot", [indices], {"depth": depth}, out=out)
+
+
+def waitall():
+    """Parity: MXNDArrayWaitAll — barrier on all async work."""
+    _jax().effects_barrier()
+
+
+# --------------------------------------------------------------------------
+# serialization — parity with NDArray::Save/Load (reference ndarray.cc):
+# our own container format (magic + names + raw tensors). The reference's
+# dmlc stream format is CUDA-era; we keep the same *semantics* (list or
+# dict of named arrays, round-trip exact).
+# --------------------------------------------------------------------------
+_MAGIC = b"MXTPU001"
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<qq", len(arrays), len(names)))
+        for n in names:
+            b = n.encode()
+            f.write(struct.pack("<q", len(b)))
+            f.write(b)
+        for a in arrays:
+            arr = a.asnumpy()
+            f.write(struct.pack("<q", mx_dtype_code(arr.dtype)))
+            f.write(struct.pack("<q", arr.ndim))
+            f.write(struct.pack("<%dq" % arr.ndim, *arr.shape))
+            f.write(np.ascontiguousarray(arr).tobytes())
+
+
+def load(fname):
+    from .base import _DTYPE_MX_TO_NP
+
+    with open(fname, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise MXNetError("invalid NDArray file %s" % fname)
+        n_arr, n_names = struct.unpack("<qq", f.read(16))
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<q", f.read(8))
+            names.append(f.read(ln).decode())
+        arrays = []
+        for _ in range(n_arr):
+            (code,) = struct.unpack("<q", f.read(8))
+            (ndim,) = struct.unpack("<q", f.read(8))
+            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+            dt = np.dtype(_DTYPE_MX_TO_NP[code])
+            count = int(np.prod(shape)) if shape else 1
+            arr = np.frombuffer(f.read(count * dt.itemsize), dtype=dt).reshape(shape)
+            arrays.append(array(arr, dtype=dt))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+# --------------------------------------------------------------------------
+# op namespace generation — parity with _init_ndarray_module
+# (reference ndarray.py:917): every registered op becomes a module function.
+# --------------------------------------------------------------------------
+def _make_ndarray_function(opdef):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        ctx = kwargs.pop("ctx", None)
+        inputs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and all(
+                isinstance(x, NDArray) for x in a
+            ):
+                inputs.extend(a)
+            else:
+                inputs.append(a)
+        result = imperative_invoke(opdef, inputs, kwargs, out=out)
+        if ctx is not None and out is None:
+            if isinstance(result, NDArray):
+                result = result.copyto(ctx) if result.context != ctx else result
+        return result
+
+    fn.__name__ = opdef.name
+    fn.__doc__ = "Auto-generated NDArray function for op %s" % opdef.name
+    return fn
+
+
+def _init_ndarray_module():
+    module = sys.modules[__name__]
+    for name, opdef in list(_registry._REGISTRY.items()):
+        if not hasattr(module, name):
+            setattr(module, name, _make_ndarray_function(opdef))
+
+
+def _init_random_module():
+    """Expose samplers as mx.random.uniform/normal/... (reference random.py)."""
+    rnd = sys.modules[_random.__name__]
+
+    def make(op):
+        def fn(*args, **kwargs):
+            # reference signature: uniform(low, high, shape, ctx, dtype)
+            names = {
+                "_sample_uniform": ("low", "high"),
+                "_sample_normal": ("loc", "scale"),
+                "_sample_gamma": ("alpha", "beta"),
+                "_sample_exponential": ("lam",),
+                "_sample_poisson": ("lam",),
+                "_sample_negbinomial": ("k", "p"),
+                "_sample_gennegbinomial": ("mu", "alpha"),
+            }[op]
+            for n, v in zip(names, args):
+                kwargs.setdefault(n, v)
+            rest = args[len(names):]
+            if rest:
+                kwargs.setdefault("shape", rest[0])
+            if len(rest) > 1:
+                kwargs.setdefault("ctx", rest[1])
+            ctx = kwargs.pop("ctx", None)
+            out = kwargs.pop("out", None)
+            if out is not None:
+                kwargs.setdefault("shape", out.shape)
+            kwargs.setdefault("shape", (1,))
+            r = imperative_invoke(_registry.get(op), [], kwargs, out=out)
+            if ctx is not None:
+                r = r.copyto(ctx)
+            return r
+
+        return fn
+
+    rnd.uniform = make("_sample_uniform")
+    rnd.normal = make("_sample_normal")
+    rnd.gamma = make("_sample_gamma")
+    rnd.exponential = make("_sample_exponential")
+    rnd.poisson = make("_sample_poisson")
+    rnd.negative_binomial = make("_sample_negbinomial")
+    rnd.generalized_negative_binomial = make("_sample_gennegbinomial")
+
+
+_init_ndarray_module()
+_init_random_module()

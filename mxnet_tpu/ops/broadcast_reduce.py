@@ -1,0 +1,205 @@
+"""Reductions and broadcast-shape ops.
+
+Parity: reference ``src/operator/tensor/broadcast_reduce_op_value.cc``
+(sum/nansum/prod/nanprod/max/min/norm, broadcast_to/broadcast_axis,
+argmax/argmin/argmax_channel). The reference hand-writes tiled reduce
+kernels (``broadcast_reduce-inl.{h,cuh}``); XLA's reduce emitter does that
+scheduling here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpDef, register
+from .utils import reduce_out_shape, same_shape_infer
+
+
+def _reduce_infer(attrs, in_shapes):
+    ishape = in_shapes[0]
+    if ishape is None:
+        raise MXNetError("reduce op: input shape required")
+    out, _ = reduce_out_shape(
+        ishape,
+        attrs.get("axis"),
+        bool(attrs.get("keepdims", False)),
+        bool(attrs.get("exclude", False)),
+    )
+    return [tuple(ishape)], [out], []
+
+
+def _register_reduce(name, fn, aliases=()):
+    def fcompute(attrs, ins, is_train, _fn=fn):
+        _, axes = reduce_out_shape(
+            ins[0].shape,
+            attrs.get("axis"),
+            False,
+            bool(attrs.get("exclude", False)),
+        )
+        out = _fn(ins[0], axis=axes, keepdims=bool(attrs.get("keepdims", False)))
+        return [out]
+
+    register(
+        OpDef(
+            name,
+            fcompute,
+            arguments=("data",),
+            defaults={"axis": None, "keepdims": False, "exclude": False},
+            infer_shape=_reduce_infer,
+            aliases=aliases,
+        )
+    )
+
+
+_register_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_register_reduce("mean", jnp.mean)
+_register_reduce("prod", jnp.prod)
+_register_reduce("nansum", jnp.nansum)
+_register_reduce("nanprod", jnp.nanprod)
+_register_reduce("max", jnp.max, aliases=("max_axis",))
+_register_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+# norm: reference flattens to a scalar L2 norm (broadcast_reduce_op_value.cc)
+register(
+    OpDef(
+        "norm",
+        lambda attrs, ins, is_train: [
+            jnp.sqrt(jnp.sum(jnp.square(ins[0].astype(jnp.float32)))).astype(
+                ins[0].dtype
+            )
+        ],
+        arguments=("data",),
+        infer_shape=lambda attrs, in_shapes: ([tuple(in_shapes[0])], [(1,)], []),
+    )
+)
+
+
+def _argminmax(fn):
+    def fcompute(attrs, ins, is_train, _fn=fn):
+        axis = attrs.get("axis")
+        keepdims = bool(attrs.get("keepdims", False))
+        x = ins[0]
+        if axis is None:
+            out = _fn(x.reshape(-1), axis=0)
+            if keepdims:
+                out = out.reshape((1,) * x.ndim)
+        else:
+            out = _fn(x, axis=int(axis))
+            if keepdims:
+                out = jnp.expand_dims(out, int(axis))
+        return [out.astype(x.dtype)]
+
+    return fcompute
+
+
+def _argminmax_infer(attrs, in_shapes):
+    ishape = in_shapes[0]
+    if ishape is None:
+        raise MXNetError("argmax/argmin: input shape required")
+    axis = attrs.get("axis")
+    keepdims = bool(attrs.get("keepdims", False))
+    if axis is None:
+        out = (1,) * len(ishape) if keepdims else ()
+    else:
+        out, _ = reduce_out_shape(ishape, int(axis), keepdims)
+    return [tuple(ishape)], [out if out else (1,)], []
+
+
+for _nm, _f in [("argmax", jnp.argmax), ("argmin", jnp.argmin)]:
+    register(
+        OpDef(
+            _nm,
+            _argminmax(_f),
+            arguments=("data",),
+            defaults={"axis": None, "keepdims": False},
+            infer_shape=_argminmax_infer,
+        )
+    )
+
+# argmax_channel: argmax over axis 1 keeping batch (reference: used by Accuracy)
+register(
+    OpDef(
+        "argmax_channel",
+        lambda attrs, ins, is_train: [
+            jnp.argmax(ins[0], axis=1).astype(ins[0].dtype)
+        ],
+        arguments=("data",),
+        infer_shape=lambda attrs, in_shapes: (
+            [tuple(in_shapes[0])],
+            [(in_shapes[0][0],) + tuple(in_shapes[0][2:])],
+            [],
+        ),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# broadcast_to / broadcast_axis
+# --------------------------------------------------------------------------
+def _broadcast_to_infer(attrs, in_shapes):
+    ishape = in_shapes[0]
+    tgt = tuple(int(d) for d in attrs["shape"])
+    if ishape is None:
+        raise MXNetError("broadcast_to: input shape required")
+    out = tuple(t if t != 0 else s for t, s in zip(tgt, ishape))
+    for s, o in zip(ishape, out):
+        if s != o and s != 1:
+            raise MXNetError("broadcast_to: cannot broadcast %s to %s" % (ishape, tgt))
+    return [tuple(ishape)], [out], []
+
+
+def _broadcast_to(attrs, ins, is_train):
+    tgt = tuple(int(d) for d in attrs["shape"])
+    out = tuple(t if t != 0 else s for t, s in zip(tgt, ins[0].shape))
+    return [jnp.broadcast_to(ins[0], out)]
+
+
+register(
+    OpDef(
+        "broadcast_to",
+        _broadcast_to,
+        arguments=("data",),
+        defaults={"shape": ()},
+        infer_shape=_broadcast_to_infer,
+    )
+)
+
+
+def _broadcast_axis(attrs, ins, is_train):
+    axes = attrs.get("axis", ())
+    sizes = attrs.get("size", ())
+    if isinstance(axes, (int, np.integer)):
+        axes = (axes,)
+    if isinstance(sizes, (int, np.integer)):
+        sizes = (sizes,)
+    out = list(ins[0].shape)
+    for a, s in zip(axes, sizes):
+        out[int(a)] = int(s)
+    return [jnp.broadcast_to(ins[0], tuple(out))]
+
+
+def _broadcast_axis_infer(attrs, in_shapes):
+    ishape = list(in_shapes[0])
+    axes = attrs.get("axis", ())
+    sizes = attrs.get("size", ())
+    if isinstance(axes, (int, np.integer)):
+        axes = (axes,)
+    if isinstance(sizes, (int, np.integer)):
+        sizes = (sizes,)
+    for a, s in zip(axes, sizes):
+        ishape[int(a)] = int(s)
+    return [tuple(in_shapes[0])], [tuple(ishape)], []
+
+
+register(
+    OpDef(
+        "broadcast_axis",
+        _broadcast_axis,
+        arguments=("data",),
+        defaults={"axis": (), "size": ()},
+        infer_shape=_broadcast_axis_infer,
+        aliases=("broadcast_axes",),
+    )
+)

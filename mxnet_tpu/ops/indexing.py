@@ -1,0 +1,260 @@
+"""Index / gather / ordering ops.
+
+Parity: reference ``src/operator/tensor/indexing_op.cc`` (take, batch_take,
+one_hot, Embedding, pick, argsort family in ``ordering_op.cc``). The
+reference's GPU path uses cub/thrust device sorts (``sort_op-inl.cuh``);
+XLA's variadic sort replaces that here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpDef, register
+
+
+# --------------------------------------------------------------------------
+# take / batch_take / Embedding
+# --------------------------------------------------------------------------
+def _take(attrs, ins, is_train):
+    a, idx = ins
+    axis = int(attrs.get("axis", 0))
+    mode = attrs.get("mode", "clip")
+    return [jnp.take(a, idx.astype(jnp.int32), axis=axis, mode=mode)]
+
+
+def _take_infer(attrs, in_shapes):
+    a, idx = in_shapes
+    if a is None or idx is None:
+        raise MXNetError("take: both shapes required")
+    axis = int(attrs.get("axis", 0))
+    out = tuple(a[:axis]) + tuple(idx) + tuple(a[axis + 1:])
+    return [tuple(a), tuple(idx)], [out], []
+
+
+register(
+    OpDef(
+        "take",
+        _take,
+        arguments=("a", "indices"),
+        defaults={"axis": 0, "mode": "clip"},
+        infer_shape=_take_infer,
+    )
+)
+
+
+def _batch_take(attrs, ins, is_train):
+    a, idx = ins
+    return [jnp.take_along_axis(a, idx.astype(jnp.int32)[:, None], axis=1)[:, 0]]
+
+
+register(
+    OpDef(
+        "batch_take",
+        _batch_take,
+        arguments=("a", "indices"),
+        infer_shape=lambda attrs, in_shapes: (
+            [tuple(in_shapes[0]), tuple(in_shapes[1])],
+            [tuple(in_shapes[1])],
+            [],
+        ),
+    )
+)
+
+
+def _embedding(attrs, ins, is_train):
+    data, weight = ins
+    idx = data.astype(jnp.int32)
+    return [jnp.take(weight, idx, axis=0)]
+
+
+def _embedding_infer(attrs, in_shapes):
+    dshape, wshape = in_shapes
+    if dshape is None:
+        raise MXNetError("Embedding: data shape required")
+    inp = int(attrs["input_dim"])
+    out = int(attrs["output_dim"])
+    wshape = (inp, out)
+    return [tuple(dshape), wshape], [tuple(dshape) + (out,)], []
+
+
+register(
+    OpDef(
+        "Embedding",
+        _embedding,
+        arguments=("data", "weight"),
+        defaults={"input_dim": 0, "output_dim": 0},
+        infer_shape=_embedding_infer,
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# one_hot / pick
+# --------------------------------------------------------------------------
+def _one_hot(attrs, ins, is_train):
+    depth = int(attrs["depth"])
+    on = float(attrs.get("on_value", 1.0))
+    off = float(attrs.get("off_value", 0.0))
+    from ..base import np_dtype
+
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    oh = jax.nn.one_hot(ins[0].astype(jnp.int32), depth)
+    return [(oh * (on - off) + off).astype(dt)]
+
+
+register(
+    OpDef(
+        "one_hot",
+        _one_hot,
+        arguments=("indices",),
+        defaults={"depth": 1, "on_value": 1.0, "off_value": 0.0, "dtype": "float32"},
+        infer_shape=lambda attrs, in_shapes: (
+            [tuple(in_shapes[0])],
+            [tuple(in_shapes[0]) + (int(attrs["depth"]),)],
+            [],
+        ),
+    )
+)
+
+
+def _pick(attrs, ins, is_train):
+    data, index = ins
+    axis = attrs.get("axis", -1)
+    axis = int(axis) if axis is not None else -1
+    keepdims = bool(attrs.get("keepdims", False))
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return [out]
+
+
+def _pick_infer(attrs, in_shapes):
+    dshape = list(in_shapes[0])
+    axis = attrs.get("axis", -1)
+    axis = int(axis) if axis is not None else -1
+    axis = axis % len(dshape)
+    keepdims = bool(attrs.get("keepdims", False))
+    ishape = dshape[:axis] + dshape[axis + 1:]
+    out = list(dshape)
+    if keepdims:
+        out[axis] = 1
+    else:
+        out = ishape
+    return [tuple(in_shapes[0]), tuple(ishape)], [tuple(out)], []
+
+
+register(
+    OpDef(
+        "pick",
+        _pick,
+        arguments=("data", "index"),
+        defaults={"axis": -1, "keepdims": False},
+        infer_shape=_pick_infer,
+        aliases=("choose_element_0index",),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# sort / argsort / topk (reference ordering_op.cc)
+# --------------------------------------------------------------------------
+def _resolve_axis(attrs, ndim):
+    axis = attrs.get("axis", -1)
+    if axis is None:
+        return None
+    return int(axis) % ndim
+
+
+def _sort(attrs, ins, is_train):
+    axis = _resolve_axis(attrs, ins[0].ndim)
+    x = ins[0].reshape(-1) if axis is None else ins[0]
+    axis = 0 if _resolve_axis(attrs, ins[0].ndim) is None else axis
+    out = jnp.sort(x, axis=axis)
+    if not bool(attrs.get("is_ascend", True)):
+        out = jnp.flip(out, axis=axis)
+    return [out]
+
+
+register(
+    OpDef(
+        "sort",
+        _sort,
+        arguments=("data",),
+        defaults={"axis": -1, "is_ascend": True},
+    )
+)
+
+
+def _argsort(attrs, ins, is_train):
+    axis = _resolve_axis(attrs, ins[0].ndim)
+    x = ins[0].reshape(-1) if axis is None else ins[0]
+    ax = 0 if axis is None else axis
+    out = jnp.argsort(x, axis=ax)
+    if not bool(attrs.get("is_ascend", True)):
+        out = jnp.flip(out, axis=ax)
+    return [out.astype(ins[0].dtype)]
+
+
+register(
+    OpDef(
+        "argsort",
+        _argsort,
+        arguments=("data",),
+        defaults={"axis": -1, "is_ascend": True},
+    )
+)
+
+
+def _topk_out_shapes(attrs, ishape):
+    axis = attrs.get("axis", -1)
+    axis = len(ishape) - 1 if axis is None else int(axis) % len(ishape)
+    k = int(attrs.get("k", 1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    s = list(ishape)
+    if ret_typ != "mask":
+        s[axis] = k
+    n_out = 2 if ret_typ == "both" else 1
+    return [tuple(s)] * n_out, axis, k, ret_typ
+
+
+def _topk(attrs, ins, is_train):
+    out_shapes, axis, k, ret_typ = _topk_out_shapes(attrs, ins[0].shape)
+    x = ins[0]
+    is_ascend = bool(attrs.get("is_ascend", False))
+    key = -x if not is_ascend else x
+    idx = jnp.argsort(key, axis=axis)
+    idx = jax.lax.slice_in_dim(idx, 0, k, axis=axis)
+    vals = jnp.take_along_axis(x, idx, axis=axis)
+    if ret_typ == "value":
+        return [vals]
+    if ret_typ == "indices":
+        return [idx.astype(x.dtype)]
+    if ret_typ == "mask":
+        m = jnp.zeros(x.shape, x.dtype)
+        m = jnp.put_along_axis(m, idx, jnp.ones_like(vals), axis=axis, inplace=False)
+        return [m]
+    return [vals, idx.astype(x.dtype)]
+
+
+def _topk_infer(attrs, in_shapes):
+    out_shapes, _, _, _ = _topk_out_shapes(attrs, in_shapes[0])
+    return [tuple(in_shapes[0])], out_shapes, []
+
+
+_topk_def = OpDef(
+    "topk",
+    _topk,
+    arguments=("data",),
+    defaults={"axis": -1, "k": 1, "ret_typ": "indices", "is_ascend": False},
+    infer_shape=_topk_infer,
+)
+_topk_def.list_outputs = lambda attrs=None: (
+    ["value", "indices"]
+    if (attrs or {}).get("ret_typ") == "both"
+    else ["output"]
+)
+register(_topk_def)

@@ -1,0 +1,98 @@
+"""Creation ops (_zeros/_ones/_arange/zeros_like/ones_like).
+
+Parity: reference ``src/operator/tensor/init_op.cc``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import np_dtype
+from .registry import OpDef, register
+from .utils import as_tuple
+
+
+def _creation_infer(attrs, in_shapes):
+    shape = as_tuple(attrs.get("shape", ()))
+    return [], [shape], []
+
+
+def _creation_type(attrs, in_types):
+    return [], [np_dtype(attrs.get("dtype", "float32"))], []
+
+
+def _register_creation(name, fill):
+    register(
+        OpDef(
+            name,
+            lambda attrs, ins, is_train, _v=fill: [
+                jnp.full(
+                    as_tuple(attrs.get("shape", ())),
+                    _v,
+                    dtype=np_dtype(attrs.get("dtype", "float32")),
+                )
+            ],
+            arguments=(),
+            defaults={"shape": (), "dtype": "float32"},
+            infer_shape=_creation_infer,
+            infer_type=_creation_type,
+        )
+    )
+
+
+_register_creation("_zeros", 0)
+_register_creation("_ones", 1)
+
+
+def _arange(attrs, ins, is_train):
+    start = float(attrs.get("start", 0.0))
+    stop = attrs.get("stop")
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    if stop is None:
+        out = np.arange(0.0, start, step)
+    else:
+        out = np.arange(start, float(stop), step)
+    if repeat > 1:
+        out = np.repeat(out, repeat)
+    return [jnp.asarray(out, dtype=dt)]
+
+
+def _arange_infer(attrs, in_shapes):
+    start = float(attrs.get("start", 0.0))
+    stop = attrs.get("stop")
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    if stop is None:
+        n = len(np.arange(0.0, start, step))
+    else:
+        n = len(np.arange(start, float(stop), step))
+    return [], [(n * repeat,)], []
+
+
+register(
+    OpDef(
+        "_arange",
+        _arange,
+        arguments=(),
+        defaults={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1, "dtype": "float32"},
+        infer_shape=_arange_infer,
+        infer_type=_creation_type,
+    )
+)
+
+register(
+    OpDef(
+        "zeros_like",
+        lambda attrs, ins, is_train: [jnp.zeros_like(ins[0])],
+        arguments=("data",),
+    )
+)
+register(
+    OpDef(
+        "ones_like",
+        lambda attrs, ins, is_train: [jnp.ones_like(ins[0])],
+        arguments=("data",),
+    )
+)

@@ -1,0 +1,1113 @@
+"""Neural-network layer operators.
+
+Parity: the reference's legacy stateful layer ops (SURVEY.md §2 N6,
+``src/operator/*-inl.h`` registered via MXNET_REGISTER_OP_PROPERTY):
+Activation, FullyConnected, Convolution, Deconvolution, Pooling, BatchNorm,
+Dropout, LRN, LeakyReLU, SoftmaxActivation/Output, regression outputs,
+MakeLoss, InstanceNorm, L2Normalization, UpSampling, SequenceLast/Mask/
+Reverse, softmax/log_softmax (``src/operator/nn/softmax.cc``),
+softmax_cross_entropy (``loss_binary_op.cc``).
+
+TPU-native notes:
+- Convolution/FullyConnected lower to ``lax.conv_general_dilated`` /
+  ``lax.dot_general`` → the MXU; fp32 accumulation is forced via
+  ``preferred_element_type`` so bf16 training matches reference fp32 curves.
+- The stateless/stateful split of the reference (OperatorProperty holding
+  cuDNN descriptors) disappears: XLA owns algorithm choice, so every layer
+  here is a pure function; BatchNorm's moving stats are threaded as aux
+  inputs/outputs (the reference mutates them via FMutateInputs).
+- Loss ops (``*Output``, MakeLoss) use jax.custom_vjp to reproduce the
+  reference contract that Executor.backward() needs no head gradient — the
+  op's backward ignores the incoming cotangent exactly as
+  ``SoftmaxOutput::Backward`` ignores out_grad.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpDef, register
+from .utils import as_tuple, same_shape_infer
+
+_ACT = {
+    "relu": lambda x: jnp.where(x > 0, x, jnp.zeros_like(x)),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+from .elemwise import elemwise_backward_infer
+
+register(
+    OpDef(
+        "Activation",
+        lambda attrs, ins, is_train: [_ACT[attrs.get("act_type", "relu")](ins[0])],
+        arguments=("data",),
+        defaults={"act_type": "relu"},
+        infer_shape=same_shape_infer(1),
+        backward_infer_shape=elemwise_backward_infer,
+    )
+)
+
+
+def _leaky_relu(attrs, ins, is_train):
+    act = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    x = ins[0]
+    if act == "leaky":
+        return [jnp.where(x > 0, x, slope * x)]
+    if act == "elu":
+        return [jnp.where(x > 0, x, slope * (jnp.exp(x) - 1.0))]
+    if act == "prelu":
+        gamma = ins[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return [jnp.where(x > 0, x, gamma * x)]
+    if act == "rrelu":
+        lo = float(attrs.get("lower_bound", 0.125))
+        up = float(attrs.get("upper_bound", 0.334))
+        if is_train:
+            key = attrs["__rng__"]
+            slope_r = jax.random.uniform(key, x.shape, minval=lo, maxval=up)
+            return [jnp.where(x > 0, x, slope_r * x)]
+        return [jnp.where(x > 0, x, ((lo + up) / 2.0) * x)]
+    raise MXNetError("LeakyReLU: unknown act_type %s" % act)
+
+
+def _leaky_relu_infer(attrs, in_shapes):
+    d = tuple(in_shapes[0])
+    if attrs.get("act_type", "leaky") == "prelu":
+        return [d, (d[1],)], [d], []
+    return [d], [d], []
+
+
+_lrelu = OpDef(
+    "LeakyReLU",
+    _leaky_relu,
+    arguments=("data",),
+    defaults={
+        "act_type": "leaky",
+        "slope": 0.25,
+        "lower_bound": 0.125,
+        "upper_bound": 0.334,
+    },
+    infer_shape=_leaky_relu_infer,
+    needs_rng=True,
+)
+_lrelu.list_arguments = lambda attrs=None: (
+    ["data", "gamma"] if (attrs or {}).get("act_type") == "prelu" else ["data"]
+)
+register(_lrelu)
+
+
+# --------------------------------------------------------------------------
+# FullyConnected — reference fully_connected-inl.h:47-135
+# --------------------------------------------------------------------------
+def _fully_connected(attrs, ins, is_train):
+    no_bias = bool(attrs.get("no_bias", False))
+    data = ins[0]
+    weight = ins[1]
+    x2d = data.reshape(data.shape[0], -1)
+    out = jax.lax.dot_general(
+        x2d,
+        weight,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not no_bias:
+        out = out + ins[2]
+    return [out]
+
+
+def _fc_infer(attrs, in_shapes):
+    nh = int(attrs["num_hidden"])
+    no_bias = bool(attrs.get("no_bias", False))
+    dshape = in_shapes[0]
+    if dshape is None:
+        raise MXNetError("FullyConnected: data shape required")
+    if 0 in tuple(dshape)[1:]:
+        # feature dims unknown (partial shape): only batch/out inferable
+        return (
+            [tuple(dshape)] + [None] * (len(in_shapes) - 1),
+            [(dshape[0], nh)],
+            [],
+        )
+    in_dim = int(np.prod(dshape[1:]))
+    shapes = [tuple(dshape), (nh, in_dim)]
+    if not no_bias:
+        shapes.append((nh,))
+    return shapes, [(dshape[0], nh)], []
+
+
+def _fc_backward_infer(attrs, in_shapes, out_shapes):
+    """Refine data batch dim (and, with known weight, the feature dim) from
+    the output — resolves RNN begin_state zeros with unknown batch."""
+    out = out_shapes[0]
+    refined = list(in_shapes)
+    dshape = in_shapes[0]
+    if out is not None and out[0] > 0:
+        wshape = in_shapes[1] if len(in_shapes) > 1 else None
+        if dshape is not None:
+            d = list(dshape)
+            if d[0] == 0:
+                d[0] = out[0]
+            if (
+                len(d) == 2
+                and d[1] == 0
+                and wshape is not None
+                and wshape[1] > 0
+            ):
+                d[1] = wshape[1]
+            refined[0] = tuple(d)
+        elif wshape is not None and all(x > 0 for x in wshape):
+            refined[0] = (out[0], wshape[1])
+    return refined
+
+
+_fc = OpDef(
+    "FullyConnected",
+    _fully_connected,
+    arguments=("data", "weight", "bias"),
+    defaults={"num_hidden": 0, "no_bias": False},
+    infer_shape=_fc_infer,
+    backward_infer_shape=_fc_backward_infer,
+)
+_fc.list_arguments = lambda attrs=None: (
+    ["data", "weight"]
+    if (attrs or {}).get("no_bias")
+    else ["data", "weight", "bias"]
+)
+register(_fc)
+
+
+# --------------------------------------------------------------------------
+# Convolution / Deconvolution — reference convolution-inl.h; lowered to
+# lax.conv_general_dilated (XLA chooses the MXU tiling; no im2col needed)
+# --------------------------------------------------------------------------
+def _conv_dims(attrs):
+    kernel = as_tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = as_tuple(attrs.get("stride") or (1,) * nd, nd, "stride")
+    dilate = as_tuple(attrs.get("dilate") or (1,) * nd, nd, "dilate")
+    pad = as_tuple(attrs.get("pad") or (0,) * nd, nd, "pad")
+    return kernel, stride, dilate, pad
+
+
+def _conv_dn(nd):
+    # NCHW / OIHW layout (reference layout); XLA relayouts internally for TPU
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return jax.lax.conv_dimension_numbers(
+        (1, 1) + (1,) * nd, (1, 1) + (1,) * nd, (lhs, rhs, lhs)
+    )
+
+
+def _convolution(attrs, ins, is_train):
+    kernel, stride, dilate, pad = _conv_dims(attrs)
+    nd = len(kernel)
+    groups = int(attrs.get("num_group", 1))
+    data, weight = ins[0], ins[1]
+    out = jax.lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(nd),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not bool(attrs.get("no_bias", False)):
+        bias = ins[2].reshape((1, -1) + (1,) * nd)
+        out = out + bias
+    return [out]
+
+
+def _conv_infer(attrs, in_shapes):
+    kernel, stride, dilate, pad = _conv_dims(attrs)
+    nd = len(kernel)
+    nf = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    no_bias = bool(attrs.get("no_bias", False))
+    dshape = in_shapes[0]
+    if dshape is None:
+        raise MXNetError("Convolution: data shape required")
+    if len(dshape) != nd + 2:
+        raise MXNetError("Convolution: data must be %dD, got %s" % (nd + 2, (dshape,)))
+    c = dshape[1]
+    wshape = (nf, c // groups) + kernel
+    out_sp = tuple(
+        (dshape[2 + i] + 2 * pad[i] - (dilate[i] * (kernel[i] - 1) + 1)) // stride[i]
+        + 1
+        for i in range(nd)
+    )
+    oshape = (dshape[0], nf) + out_sp
+    shapes = [tuple(dshape), wshape] + ([] if no_bias else [(nf,)])
+    return shapes, [oshape], []
+
+
+_conv = OpDef(
+    "Convolution",
+    _convolution,
+    arguments=("data", "weight", "bias"),
+    defaults={
+        "kernel": (1, 1),
+        "stride": None,
+        "dilate": None,
+        "pad": None,
+        "num_filter": 1,
+        "num_group": 1,
+        "no_bias": False,
+        "workspace": 1024,
+        "cudnn_tune": None,
+        "cudnn_off": False,
+        "layout": None,
+    },
+    infer_shape=_conv_infer,
+)
+_conv.list_arguments = lambda attrs=None: (
+    ["data", "weight"]
+    if (attrs or {}).get("no_bias")
+    else ["data", "weight", "bias"]
+)
+register(_conv)
+from .registry import _REGISTRY as _R
+
+_R["Convolution_v1"] = _conv  # reference keeps the pre-NNVM name alive
+
+
+def _deconvolution(attrs, ins, is_train):
+    kernel, stride, dilate, pad = _conv_dims(attrs)
+    nd = len(kernel)
+    groups = int(attrs.get("num_group", 1))
+    adj = as_tuple(attrs.get("adj") or (0,) * nd, nd, "adj")
+    data, weight = ins[0], ins[1]
+    # Transposed conv = gradient of conv wrt its input: lhs-dilated conv with
+    # flipped kernel (weight layout (C_in, C_out/g, *K) as in the reference).
+    out = jax.lax.conv_transpose(
+        data,
+        weight,
+        strides=stride,
+        padding=[(p, p - a) for p, a in zip(pad, adj)],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(nd),
+        transpose_kernel=True,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not bool(attrs.get("no_bias", True)):
+        out = out + ins[2].reshape((1, -1) + (1,) * nd)
+    return [out]
+
+
+def _deconv_infer(attrs, in_shapes):
+    kernel, stride, dilate, pad = _conv_dims(attrs)
+    nd = len(kernel)
+    nf = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    no_bias = bool(attrs.get("no_bias", True))
+    adj = as_tuple(attrs.get("adj") or (0,) * nd, nd, "adj")
+    dshape = in_shapes[0]
+    c = dshape[1]
+    wshape = (c, nf // groups) + kernel
+    out_sp = tuple(
+        stride[i] * (dshape[2 + i] - 1)
+        + (dilate[i] * (kernel[i] - 1) + 1)
+        - 2 * pad[i]
+        + adj[i]
+        for i in range(nd)
+    )
+    oshape = (dshape[0], nf) + out_sp
+    shapes = [tuple(dshape), wshape] + ([] if no_bias else [(nf,)])
+    return shapes, [oshape], []
+
+
+_deconv = OpDef(
+    "Deconvolution",
+    _deconvolution,
+    arguments=("data", "weight", "bias"),
+    defaults={
+        "kernel": (1, 1),
+        "stride": None,
+        "dilate": None,
+        "pad": None,
+        "adj": None,
+        "target_shape": None,
+        "num_filter": 1,
+        "num_group": 1,
+        "no_bias": True,
+        "workspace": 512,
+    },
+    infer_shape=_deconv_infer,
+)
+_deconv.list_arguments = lambda attrs=None: (
+    ["data", "weight"]
+    if (attrs or {}).get("no_bias", True)
+    else ["data", "weight", "bias"]
+)
+register(_deconv)
+
+
+# --------------------------------------------------------------------------
+# Pooling — reference pooling-inl.h; lax.reduce_window
+# --------------------------------------------------------------------------
+def _pool_out_dim(x, k, s, p, convention):
+    if convention == "full":
+        return int(np.ceil(float(x + 2 * p - k) / s)) + 1
+    return (x + 2 * p - k) // s + 1
+
+
+def _pooling(attrs, ins, is_train):
+    data = ins[0]
+    nd = data.ndim - 2
+    global_pool = bool(attrs.get("global_pool", False))
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = as_tuple(attrs["kernel"])
+        stride = as_tuple(attrs.get("stride") or (1,) * nd, nd, "stride")
+        pad = as_tuple(attrs.get("pad") or (0,) * nd, nd, "pad")
+    ptype = attrs.get("pool_type", "max")
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    # init values MUST be python scalars: a traced init keeps XLA from
+    # recognizing the differentiable reduce_window_max/add patterns and
+    # vjp-under-jit fails to linearize.
+    if ptype == "max":
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            init = -float(np.inf)
+        else:
+            init = int(np.iinfo(np.dtype(data.dtype)).min)
+        out = jax.lax.reduce_window(
+            data, init, jax.lax.max, window, strides, padding
+        )
+    elif ptype in ("avg", "sum"):
+        zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
+        out = jax.lax.reduce_window(
+            data, zero, jax.lax.add, window, strides, padding
+        )
+        if ptype == "avg":
+            # divisor = clipped window area (mshadow pool divides by the
+            # valid in-bounds window size at the borders)
+            ones = jnp.ones(data.shape[2:], data.dtype)
+            counts = jax.lax.reduce_window(
+                ones, zero, jax.lax.add, window[2:], strides[2:], padding[2:]
+            )
+            out = out / counts
+    else:
+        raise MXNetError("Pooling: unknown pool_type %s" % ptype)
+    return [out]
+
+
+def _pooling_infer(attrs, in_shapes):
+    dshape = in_shapes[0]
+    nd = len(dshape) - 2
+    if bool(attrs.get("global_pool", False)):
+        return [tuple(dshape)], [tuple(dshape[:2]) + (1,) * nd], []
+    kernel = as_tuple(attrs["kernel"])
+    stride = as_tuple(attrs.get("stride") or (1,) * nd, nd, "stride")
+    pad = as_tuple(attrs.get("pad") or (0,) * nd, nd, "pad")
+    conv = attrs.get("pooling_convention", "valid")
+    out_sp = tuple(
+        _pool_out_dim(dshape[2 + i], kernel[i], stride[i], pad[i], conv)
+        for i in range(nd)
+    )
+    return [tuple(dshape)], [tuple(dshape[:2]) + out_sp], []
+
+
+register(
+    OpDef(
+        "Pooling",
+        _pooling,
+        arguments=("data",),
+        defaults={
+            "kernel": (1, 1),
+            "stride": None,
+            "pad": None,
+            "pool_type": "max",
+            "global_pool": False,
+            "pooling_convention": "valid",
+            "cudnn_off": False,
+        },
+        infer_shape=_pooling_infer,
+        aliases=("Pooling_v1",),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# BatchNorm — reference batch_norm-inl.h. aux: moving_mean/moving_var;
+# outputs (output, save_mean, save_var) with 1 visible. Per-replica stats
+# (no cross-replica sync) to match reference convergence (SURVEY.md §7).
+# --------------------------------------------------------------------------
+def _batch_norm(attrs, ins, is_train):
+    data, gamma, beta, moving_mean, moving_var = ins
+    eps = float(attrs.get("eps", 1e-3))
+    momentum = float(attrs.get("momentum", 0.9))
+    fix_gamma = bool(attrs.get("fix_gamma", True))
+    use_global = bool(attrs.get("use_global_stats", False)) or not is_train
+    ax = tuple(i for i in range(data.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma) + jax.lax.stop_gradient(gamma * 0)
+    if use_global:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+        out = (data - mean.reshape(bshape)) * jax.lax.rsqrt(
+            var.reshape(bshape) + eps
+        ) * gamma.reshape(bshape) + beta.reshape(bshape)
+    else:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=ax)
+        var = jnp.mean(jnp.square(x32 - mean.reshape(bshape)), axis=ax)
+        out = (
+            (x32 - mean.reshape(bshape))
+            * jax.lax.rsqrt(var.reshape(bshape) + eps)
+            * gamma.reshape(bshape).astype(jnp.float32)
+            + beta.reshape(bshape).astype(jnp.float32)
+        ).astype(data.dtype)
+        new_mean = momentum * moving_mean + (1.0 - momentum) * mean.astype(
+            moving_mean.dtype
+        )
+        new_var = momentum * moving_var + (1.0 - momentum) * var.astype(
+            moving_var.dtype
+        )
+    return [out, mean.astype(jnp.float32), var.astype(jnp.float32), new_mean, new_var]
+
+
+def _bn_infer(attrs, in_shapes):
+    dshape = in_shapes[0]
+    if dshape is None:
+        raise MXNetError("BatchNorm: data shape required")
+    c = (dshape[1],)
+    return (
+        [tuple(dshape), c, c],
+        [tuple(dshape), c, c],
+        [c, c],
+    )
+
+
+_bn = OpDef(
+    "BatchNorm",
+    _batch_norm,
+    arguments=("data", "gamma", "beta"),
+    outputs=("output", "mean", "var"),
+    aux=("moving_mean", "moving_var"),
+    defaults={
+        "eps": 1e-3,
+        "momentum": 0.9,
+        "fix_gamma": True,
+        "use_global_stats": False,
+        "output_mean_var": False,
+    },
+    infer_shape=_bn_infer,
+    aliases=("CuDNNBatchNorm",),
+)
+_bn._num_visible_outputs = 1
+register(_bn)
+
+
+# --------------------------------------------------------------------------
+# InstanceNorm / L2Normalization / LRN
+# --------------------------------------------------------------------------
+def _instance_norm(attrs, ins, is_train):
+    data, gamma, beta = ins
+    eps = float(attrs.get("eps", 1e-3))
+    ax = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return [
+        (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(bshape)
+        + beta.reshape(bshape)
+    ]
+
+
+register(
+    OpDef(
+        "InstanceNorm",
+        _instance_norm,
+        arguments=("data", "gamma", "beta"),
+        defaults={"eps": 1e-3},
+        infer_shape=lambda attrs, in_shapes: (
+            [tuple(in_shapes[0]), (in_shapes[0][1],), (in_shapes[0][1],)],
+            [tuple(in_shapes[0])],
+            [],
+        ),
+    )
+)
+
+
+def _l2_normalization(attrs, ins, is_train):
+    data = ins[0]
+    eps = float(attrs.get("eps", 1e-10))
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, data.ndim))
+    else:
+        raise MXNetError("L2Normalization: unknown mode %s" % mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return [data / norm]
+
+
+register(
+    OpDef(
+        "L2Normalization",
+        _l2_normalization,
+        arguments=("data",),
+        defaults={"eps": 1e-10, "mode": "instance"},
+        infer_shape=same_shape_infer(1),
+    )
+)
+
+
+def _lrn(attrs, ins, is_train):
+    x = ins[0]
+    nsize = int(attrs.get("nsize", 5))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    knorm = float(attrs.get("knorm", 2.0))
+    sq = jnp.square(x)
+    half = nsize // 2
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (x.ndim - 2)
+    sq_pad = jnp.pad(sq, pad)
+    window = jnp.stack(
+        [sq_pad[:, i : i + x.shape[1]] for i in range(nsize)], axis=0
+    ).sum(axis=0)
+    return [x * jnp.power(knorm + (alpha / nsize) * window, -beta)]
+
+
+register(
+    OpDef(
+        "LRN",
+        _lrn,
+        arguments=("data",),
+        defaults={"nsize": 5, "alpha": 1e-4, "beta": 0.75, "knorm": 2.0},
+        infer_shape=same_shape_infer(1),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# Dropout — reference dropout-inl.h (scale-at-train, identity at eval)
+# --------------------------------------------------------------------------
+def _dropout(attrs, ins, is_train):
+    p = float(attrs.get("p", 0.5))
+    if not is_train or p <= 0.0:
+        return [ins[0]]
+    key = attrs["__rng__"]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, ins[0].shape)
+    return [jnp.where(mask, ins[0] / keep, jnp.zeros_like(ins[0]))]
+
+
+register(
+    OpDef(
+        "Dropout",
+        _dropout,
+        arguments=("data",),
+        defaults={"p": 0.5, "mode": "training"},
+        infer_shape=same_shape_infer(1),
+        needs_rng=True,
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# softmax / log_softmax / SoftmaxActivation
+# --------------------------------------------------------------------------
+register(
+    OpDef(
+        "softmax",
+        lambda attrs, ins, is_train: [
+            jax.nn.softmax(ins[0], axis=int(attrs.get("axis", -1)))
+        ],
+        arguments=("data",),
+        defaults={"axis": -1, "temperature": None},
+        infer_shape=same_shape_infer(1),
+    )
+)
+register(
+    OpDef(
+        "log_softmax",
+        lambda attrs, ins, is_train: [
+            jax.nn.log_softmax(ins[0], axis=int(attrs.get("axis", -1)))
+        ],
+        arguments=("data",),
+        defaults={"axis": -1, "temperature": None},
+        infer_shape=same_shape_infer(1),
+    )
+)
+register(
+    OpDef(
+        "SoftmaxActivation",
+        lambda attrs, ins, is_train: [
+            jax.nn.softmax(ins[0], axis=1)
+            if attrs.get("mode", "instance") == "channel"
+            else jax.nn.softmax(
+                ins[0].reshape(ins[0].shape[0], -1), axis=-1
+            ).reshape(ins[0].shape)
+        ],
+        arguments=("data",),
+        defaults={"mode": "instance"},
+        infer_shape=same_shape_infer(1),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# SoftmaxOutput and friends — loss heads with reference backward semantics
+# --------------------------------------------------------------------------
+def _normalize_grad(grad, label, attrs, valid_mask=None):
+    normalization = attrs.get("normalization", "null")
+    if normalization == "batch":
+        grad = grad / label.shape[0]
+    elif normalization == "valid" and valid_mask is not None:
+        grad = grad / jnp.maximum(valid_mask.sum(), 1.0)
+    elif normalization == "valid":
+        grad = grad / float(np.prod(label.shape))
+    return grad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_output_core(data, label, attr_key):
+    attrs = dict(attr_key)
+    if attrs.get("multi_output") and data.ndim > 2:
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, attr_key):
+    out = _softmax_output_core(data, label, attr_key)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(attr_key, res, g):
+    # Reference contract: backward ignores the head gradient entirely
+    # (softmax_output-inl.h Backward). g is unused by design.
+    out, label = res
+    attrs = dict(attr_key)
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+    use_ignore = bool(attrs.get("use_ignore", False))
+    ignore_label = float(attrs.get("ignore_label", -1.0))
+    multi = bool(attrs.get("multi_output", False)) and out.ndim > 2
+    axis = 1 if multi else -1
+    depth = out.shape[axis]
+    lbl = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lbl, depth, dtype=out.dtype)
+    if multi:
+        # label (n, d1...) → put class axis at 1
+        onehot = jnp.moveaxis(onehot, -1, 1)
+    grad = out - onehot
+    valid = None
+    if use_ignore:
+        mask = (label != ignore_label).astype(out.dtype)
+        valid = mask
+        grad = grad * jnp.expand_dims(mask, axis=axis)
+    grad = _normalize_grad(grad * grad_scale, label, attrs, valid)
+    return grad.astype(out.dtype), jnp.zeros_like(label)
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+def _softmax_output(attrs, ins, is_train):
+    attr_key = tuple(
+        sorted((k, v) for k, v in attrs.items() if not k.startswith("__") and not isinstance(v, jax.Array))
+    )
+    return [_softmax_output_core(ins[0], ins[1], attr_key)]
+
+
+def _softmax_output_infer(attrs, in_shapes):
+    dshape = in_shapes[0]
+    if dshape is None:
+        raise MXNetError("SoftmaxOutput: data shape required")
+    if attrs.get("multi_output") and len(dshape) > 2:
+        lshape = (dshape[0],) + tuple(dshape[2:])
+    else:
+        lshape = tuple(dshape[:-1]) if len(dshape) > 1 else (dshape[0],)
+    return [tuple(dshape), lshape], [tuple(dshape)], []
+
+
+register(
+    OpDef(
+        "SoftmaxOutput",
+        _softmax_output,
+        arguments=("data", "label"),
+        defaults={
+            "grad_scale": 1.0,
+            "ignore_label": -1.0,
+            "use_ignore": False,
+            "multi_output": False,
+            "normalization": "null",
+            "preserve_shape": False,
+            "out_grad": False,
+        },
+        infer_shape=_softmax_output_infer,
+        need_top_grad=False,
+        aliases=("Softmax",),
+    )
+)
+
+
+def _make_output_op(name, bwd_fn, act=lambda x: x):
+    """Regression output heads (linear/logistic/MAE) — backward ignores the
+    head gradient, grad = bwd_fn(out, label) * grad_scale / batch."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return act(data)
+
+    def fwd(data, label, grad_scale):
+        out = core(data, label, grad_scale)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        n = float(np.prod(out.shape[1:])) if out.ndim > 1 else 1.0
+        grad = bwd_fn(out, label.reshape(out.shape)) * (grad_scale / n)
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    core.defvjp(fwd, bwd)
+
+    def fcompute(attrs, ins, is_train):
+        return [core(ins[0], ins[1], float(attrs.get("grad_scale", 1.0)))]
+
+    register(
+        OpDef(
+            name,
+            fcompute,
+            arguments=("data", "label"),
+            defaults={"grad_scale": 1.0},
+            infer_shape=lambda attrs, in_shapes: (
+                [tuple(in_shapes[0]), tuple(in_shapes[0])],
+                [tuple(in_shapes[0])],
+                [],
+            ),
+            need_top_grad=False,
+        )
+    )
+
+
+_make_output_op("LinearRegressionOutput", lambda o, l: o - l)
+_make_output_op(
+    "LogisticRegressionOutput", lambda o, l: o - l, act=jax.nn.sigmoid
+)
+_make_output_op("MAERegressionOutput", lambda o, l: jnp.sign(o - l))
+
+
+# SVMOutput — reference svm_output-inl.h: hinge loss gradients
+def _svm_output(attrs, ins, is_train):
+    margin = float(attrs.get("margin", 1.0))
+    reg = float(attrs.get("regularization_coefficient", 1.0))
+    use_linear = bool(attrs.get("use_linear", False))
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+    def core(data, label, margin, reg, use_linear):
+        return data
+
+    def fwd(data, label, margin, reg, use_linear):
+        return data, (data, label)
+
+    def bwd(margin, reg, use_linear, res, g):
+        data, label = res
+        lbl = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lbl, data.shape[-1], dtype=data.dtype)
+        sign = 2.0 * onehot - 1.0  # +1 at true class, -1 elsewhere
+        viol = (margin - sign * data) > 0
+        if use_linear:
+            grad = jnp.where(viol, -sign * reg, 0.0)
+        else:
+            grad = jnp.where(viol, -2.0 * (margin - sign * data) * sign * reg, 0.0)
+        return grad.astype(data.dtype), jnp.zeros_like(label)
+
+    core.defvjp(fwd, bwd)
+    return [core(ins[0], ins[1], margin, reg, use_linear)]
+
+
+register(
+    OpDef(
+        "SVMOutput",
+        _svm_output,
+        arguments=("data", "label"),
+        defaults={
+            "margin": 1.0,
+            "regularization_coefficient": 1.0,
+            "use_linear": False,
+        },
+        infer_shape=lambda attrs, in_shapes: (
+            [tuple(in_shapes[0]), (in_shapes[0][0],)],
+            [tuple(in_shapes[0])],
+            [],
+        ),
+        need_top_grad=False,
+    )
+)
+
+
+# MakeLoss layer — reference make_loss-inl.h
+def _make_loss(attrs, ins, is_train):
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+    normalization = attrs.get("normalization", "null")
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+    def core(data, gs, norm):
+        return data
+
+    def fwd(data, gs, norm):
+        return data, data
+
+    def bwd(gs, norm, res, g):
+        data = res
+        scale = gs
+        if norm == "batch":
+            scale = gs / data.shape[0]
+        return (jnp.full(data.shape, scale, data.dtype),)
+
+    core.defvjp(fwd, bwd)
+    return [core(ins[0], grad_scale, normalization)]
+
+
+register(
+    OpDef(
+        "MakeLoss",
+        _make_loss,
+        arguments=("data",),
+        defaults={"grad_scale": 1.0, "valid_thresh": 0.0, "normalization": "null"},
+        infer_shape=same_shape_infer(1),
+        need_top_grad=False,
+    )
+)
+
+
+# softmax_cross_entropy — reference loss_binary_op.cc
+def _softmax_cross_entropy(attrs, ins, is_train):
+    data, label = ins
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lbl = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+    return [-jnp.sum(picked).reshape(1)]
+
+
+register(
+    OpDef(
+        "softmax_cross_entropy",
+        _softmax_cross_entropy,
+        arguments=("data", "label"),
+        infer_shape=lambda attrs, in_shapes: (
+            [tuple(in_shapes[0]), (in_shapes[0][0],)],
+            [(1,)],
+            [],
+        ),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# UpSampling — reference upsampling-inl.h (nearest; bilinear via Deconvolution)
+# --------------------------------------------------------------------------
+def _upsampling(attrs, ins, is_train):
+    scale = int(attrs["scale"])
+    sample_type = attrs.get("sample_type", "nearest")
+    if sample_type == "nearest":
+        outs = []
+        target = None
+        for x in ins:
+            h, w = x.shape[2], x.shape[3]
+            if target is None:
+                target = (h * scale, w * scale)
+            s = target[0] // h
+            up = jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+            outs.append(up)
+        return [jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]]
+    # bilinear: single input + weight, implemented via resize
+    x = ins[0]
+    out = jax.image.resize(
+        x,
+        (x.shape[0], x.shape[1], x.shape[2] * scale, x.shape[3] * scale),
+        method="bilinear",
+    )
+    return [out]
+
+
+def _upsampling_infer(attrs, in_shapes):
+    scale = int(attrs["scale"])
+    sample_type = attrs.get("sample_type", "nearest")
+    d0 = in_shapes[0]
+    if sample_type == "bilinear":
+        nf = int(attrs.get("num_filter", d0[1]))
+        kernel = 2 * scale - scale % 2
+        wshape = (d0[1], 1, kernel, kernel)
+        return (
+            [tuple(d0), wshape],
+            [(d0[0], d0[1], d0[2] * scale, d0[3] * scale)],
+            [],
+        )
+    c = sum(s[1] for s in in_shapes)
+    return (
+        [tuple(s) for s in in_shapes],
+        [(d0[0], c, d0[2] * scale, d0[3] * scale)],
+        [],
+    )
+
+
+_ups = OpDef(
+    "UpSampling",
+    _upsampling,
+    arguments=("data",),
+    key_var_num_args="num_args",
+    defaults={
+        "scale": 1,
+        "num_filter": 0,
+        "sample_type": "nearest",
+        "multi_input_mode": "concat",
+        "num_args": 1,
+        "workspace": 512,
+    },
+    infer_shape=_upsampling_infer,
+)
+register(_ups)
+
+
+# --------------------------------------------------------------------------
+# Sequence ops — reference sequence_last/mask/reverse-inl.h
+# (TDNC layout: (seq_len, batch, ...))
+# --------------------------------------------------------------------------
+def _seq_lengths(attrs, ins, maxlen, batch):
+    if bool(attrs.get("use_sequence_length", False)) and len(ins) > 1:
+        return ins[1].astype(jnp.int32)
+    return jnp.full((batch,), maxlen, jnp.int32)
+
+
+def _sequence_last(attrs, ins, is_train):
+    data = ins[0]
+    lengths = _seq_lengths(attrs, ins, data.shape[0], data.shape[1])
+    idx = jnp.maximum(lengths - 1, 0)
+    return [jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+    )[0]]
+
+
+_seq_last = OpDef(
+    "SequenceLast",
+    _sequence_last,
+    arguments=("data", "sequence_length"),
+    defaults={"use_sequence_length": False},
+    infer_shape=lambda attrs, in_shapes: (
+        [tuple(s) for s in in_shapes if s is not None],
+        [tuple(in_shapes[0][1:])],
+        [],
+    ),
+)
+_seq_last.list_arguments = lambda attrs=None: (
+    ["data", "sequence_length"]
+    if (attrs or {}).get("use_sequence_length")
+    else ["data"]
+)
+register(_seq_last)
+
+
+def _sequence_mask(attrs, ins, is_train):
+    data = ins[0]
+    value = float(attrs.get("value", 0.0))
+    lengths = _seq_lengths(attrs, ins, data.shape[0], data.shape[1])
+    t = jnp.arange(data.shape[0])[:, None]
+    mask = t < lengths[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return [jnp.where(mask, data, jnp.asarray(value, data.dtype))]
+
+
+_seq_mask = OpDef(
+    "SequenceMask",
+    _sequence_mask,
+    arguments=("data", "sequence_length"),
+    defaults={"use_sequence_length": False, "value": 0.0},
+    infer_shape=lambda attrs, in_shapes: (
+        [tuple(s) for s in in_shapes if s is not None],
+        [tuple(in_shapes[0])],
+        [],
+    ),
+)
+_seq_mask.list_arguments = _seq_last.list_arguments
+register(_seq_mask)
+
+
+def _sequence_reverse(attrs, ins, is_train):
+    data = ins[0]
+    lengths = _seq_lengths(attrs, ins, data.shape[0], data.shape[1])
+    maxlen = data.shape[0]
+    t = jnp.arange(maxlen)[:, None]
+    src = jnp.where(t < lengths[None, :], lengths[None, :] - 1 - t, t)
+    return [jnp.take_along_axis(
+        data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=0
+    )]
+
+
+_seq_rev = OpDef(
+    "SequenceReverse",
+    _sequence_reverse,
+    arguments=("data", "sequence_length"),
+    defaults={"use_sequence_length": False},
+    infer_shape=lambda attrs, in_shapes: (
+        [tuple(s) for s in in_shapes if s is not None],
+        [tuple(in_shapes[0])],
+        [],
+    ),
+)
+_seq_rev.list_arguments = _seq_last.list_arguments
+register(_seq_rev)
+
+
+# --------------------------------------------------------------------------
+# Crop layer (reference crop-inl.h) — crop first input to match second (or
+# h_w attr), offset-based
+# --------------------------------------------------------------------------
+def _crop(attrs, ins, is_train):
+    x = ins[0]
+    if len(ins) > 1:
+        th, tw = ins[1].shape[2], ins[1].shape[3]
+    else:
+        th, tw = as_tuple(attrs["h_w"], 2, "h_w")
+    if bool(attrs.get("center_crop", False)):
+        oy = (x.shape[2] - th) // 2
+        ox = (x.shape[3] - tw) // 2
+    else:
+        oy, ox = as_tuple(attrs.get("offset", (0, 0)), 2, "offset")
+    return [x[:, :, oy : oy + th, ox : ox + tw]]
+
+
+def _crop_infer(attrs, in_shapes):
+    d0 = in_shapes[0]
+    if int(attrs.get("num_args", 1)) > 1 and len(in_shapes) > 1 and in_shapes[1]:
+        th, tw = in_shapes[1][2], in_shapes[1][3]
+    else:
+        th, tw = as_tuple(attrs["h_w"], 2, "h_w")
+    return (
+        [tuple(s) for s in in_shapes],
+        [(d0[0], d0[1], th, tw)],
+        [],
+    )
+
+
+register(
+    OpDef(
+        "Crop",
+        _crop,
+        arguments=("data",),
+        key_var_num_args="num_args",
+        defaults={"num_args": 1, "offset": (0, 0), "h_w": (0, 0), "center_crop": False},
+        infer_shape=_crop_infer,
+    )
+)

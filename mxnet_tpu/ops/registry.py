@@ -1,0 +1,187 @@
+"""Operator registry.
+
+This single registry replaces three reference subsystems at once:
+
+- the NNVM ``Op`` registry with per-op attribute maps (reference vendored
+  ``nnvm/``; attrs used by MXNet listed in SURVEY.md §2 N19),
+- the legacy ``OperatorProperty`` registration (`MXNET_REGISTER_OP_PROPERTY`,
+  reference ``include/mxnet/operator.h:166+`` bridged by
+  ``src/nnvm/legacy_op_util.cc:304``),
+- mshadow/cuDNN kernels (each op's ``fcompute`` is a pure JAX function that
+  XLA fuses and schedules on the MXU/VPU).
+
+An op is stateless and pure: ``fcompute(attrs, inputs, is_train)`` maps JAX
+arrays to JAX arrays. Ops with auxiliary state (BatchNorm moving stats —
+reference mutates them in forward via FMutateInputs) take aux arrays as
+trailing inputs and return updated aux as trailing outputs; the executor and
+imperative layers thread the state functionally.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError, parse_attr_value
+
+_REGISTRY: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """Metadata + compute for one operator."""
+
+    def __init__(
+        self,
+        name,
+        fcompute,
+        arguments=("data",),
+        outputs=("output",),
+        aux=(),
+        defaults=None,
+        infer_shape=None,
+        infer_type=None,
+        backward_infer_shape=None,
+        key_var_num_args=None,
+        aliases=(),
+        need_top_grad=True,
+        visible=True,
+        needs_rng=False,
+        mutate_inputs=(),
+    ):
+        self.name = name
+        self.fcompute = fcompute
+        self._arguments = list(arguments)
+        self._outputs = list(outputs)
+        self._aux = list(aux)
+        self.defaults = dict(defaults or {})
+        self._infer_shape = infer_shape
+        self._infer_type = infer_type
+        # Optional reverse inference: (attrs, in_shapes, out_shapes) ->
+        # refined in_shapes. The lightweight stand-in for nnvm's
+        # bidirectional InferShape pass — needed where consumers determine
+        # producers (RNN begin_state zeros with unknown batch).
+        self.backward_infer_shape = backward_infer_shape
+        # like NNVM's key_var_num_args: attr holding the variable input count
+        # (Concat's num_args, add_n's num_args)
+        self.key_var_num_args = key_var_num_args
+        self.aliases = list(aliases)
+        # False for loss/output ops whose backward ignores the head gradient
+        # (reference SoftmaxOutput/MakeLoss semantics)
+        self.need_top_grad = need_top_grad
+        self.visible = visible
+        # Ops needing randomness (samplers, Dropout) get a fresh PRNG key in
+        # attrs["__rng__"]; JAX threefry replaces mshadow's global PRNG
+        # (reference src/resource.cc kRandom) — functional keys instead of a
+        # mutable engine-protected generator.
+        self.needs_rng = needs_rng
+        # Indices of inputs the reference op mutates in place (FMutateInputs:
+        # sgd_mom_update's momentum). fcompute returns the updated values as
+        # extra trailing outputs; the imperative layer writes them back.
+        self.mutate_inputs = tuple(mutate_inputs)
+
+    # -- attr handling ------------------------------------------------------
+    def canon_attrs(self, raw_attrs):
+        """Parse string attrs and fill defaults (dmlc::Parameter equivalent)."""
+        attrs = dict(self.defaults)
+        for k, v in (raw_attrs or {}).items():
+            if k.startswith("__"):  # __ctx_group__ etc. — graph-level attrs
+                continue
+            attrs[k] = parse_attr_value(v)
+        return attrs
+
+    # -- arity --------------------------------------------------------------
+    def num_inputs(self, attrs):
+        if self.key_var_num_args is not None:
+            n = attrs.get(self.key_var_num_args)
+            if n is None:
+                raise MXNetError(
+                    "%s requires attr %s" % (self.name, self.key_var_num_args)
+                )
+            return int(n)
+        return len(self._arguments)
+
+    def list_arguments(self, attrs=None):
+        if self.key_var_num_args is not None and attrs is not None:
+            n = int(attrs.get(self.key_var_num_args, 1))
+            return ["arg%d" % i for i in range(n)]
+        return list(self._arguments)
+
+    def list_outputs(self, attrs=None):
+        return list(self._outputs)
+
+    def num_visible_outputs(self, attrs=None):
+        """Outputs visible to Symbol composition (reference
+        OperatorProperty::NumVisibleOutputs — BatchNorm exposes 1 of 3)."""
+        if getattr(self, "_num_visible_outputs", None) is not None:
+            return self._num_visible_outputs
+        return len(self.list_outputs(attrs))
+
+    def list_auxiliary_states(self, attrs=None):
+        return list(self._aux)
+
+    # -- inference ----------------------------------------------------------
+    def infer_shape(self, attrs, in_shapes):
+        """(in_shapes with Nones) -> (completed in, out, aux shapes)."""
+        if self._infer_shape is not None:
+            return self._infer_shape(attrs, in_shapes)
+        # default: all inputs/outputs share one (dim-merged) shape
+        from .utils import merge_shapes
+
+        merged = None
+        for s in in_shapes:
+            merged = merge_shapes(merged, s, self.name)
+        if merged is None:
+            raise MXNetError("%s: cannot infer shape, no known inputs" % self.name)
+        return (
+            [merged] * len(in_shapes),
+            [merged] * len(self._outputs),
+            [],
+        )
+
+    def infer_type(self, attrs, in_types):
+        import numpy as np
+
+        if self._infer_type is not None:
+            return self._infer_type(attrs, in_types)
+        known = [t for t in in_types if t is not None]
+        if not known:
+            raise MXNetError("%s: cannot infer type" % self.name)
+        t = known[0]
+        completed = [t if x is None else x for x in in_types]
+        return completed, [t] * len(self._outputs), [np.float32] * len(self._aux)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(opdef: OpDef):
+    for name in [opdef.name] + opdef.aliases:
+        if name in _REGISTRY:
+            raise MXNetError("op %s already registered" % name)
+        _REGISTRY[name] = opdef
+    return opdef
+
+
+def register_op(name, fcompute, **kwargs):
+    return register(OpDef(name, fcompute, **kwargs))
+
+
+def get(name) -> OpDef:
+    op = _REGISTRY.get(name)
+    if op is None:
+        raise MXNetError("operator %s is not registered" % name)
+    return op
+
+
+def exists(name) -> bool:
+    return name in _REGISTRY
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+def primary_ops():
+    """Unique OpDefs (no alias duplicates)."""
+    seen, out = set(), []
+    for op in _REGISTRY.values():
+        if id(op) not in seen:
+            seen.add(id(op))
+            out.append(op)
+    return out

@@ -1,0 +1,232 @@
+"""Fused RNN operator (multi-layer LSTM/GRU/vanilla RNN).
+
+Parity: reference ``src/operator/rnn-inl.h`` + ``cudnn_rnn-inl.h`` (the
+``RNN`` op used by FusedRNNCell, rnn/rnn_cell.py:497). The reference
+delegates to cuDNN's fused RNN; here the recurrence is a ``lax.scan`` whose
+per-step gate matmuls hit the MXU and whose sequential loop XLA pipelines —
+the idiomatic TPU formulation of a fused RNN.
+
+Weight layout matches cuDNN packing so FusedRNNCell.unfuse()/checkpoint
+compatibility holds: per layer/direction, [W_i2h (gates*H, I), W_h2h
+(gates*H, H)] concatenated across layers, then all biases [b_i2h, b_h2h].
+Gate order: LSTM i,f,g(c~),o ; GRU r,z,n (cuDNN order, as the reference's
+FusedRNNCell documents).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpDef, register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        inp = input_size if layer == 0 else state_size * dirs
+        size += dirs * gates * state_size * (inp + state_size)  # weights
+        size += dirs * gates * state_size * 2  # biases
+    return size
+
+
+def _unpack_params(params, num_layers, input_size, state_size, bidirectional, mode):
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    ws, bs = [], []
+    off = 0
+    for layer in range(num_layers):
+        inp = input_size if layer == 0 else state_size * dirs
+        layer_ws = []
+        for _ in range(dirs):
+            wi = params[off : off + gates * state_size * inp].reshape(
+                gates * state_size, inp
+            )
+            off += gates * state_size * inp
+            wh = params[off : off + gates * state_size * state_size].reshape(
+                gates * state_size, state_size
+            )
+            off += gates * state_size * state_size
+            layer_ws.append((wi, wh))
+        ws.append(layer_ws)
+    for layer in range(num_layers):
+        layer_bs = []
+        for _ in range(dirs):
+            bi = params[off : off + gates * state_size]
+            off += gates * state_size
+            bh = params[off : off + gates * state_size]
+            off += gates * state_size
+            layer_bs.append((bi, bh))
+        bs.append(layer_bs)
+    return ws, bs
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+
+        def step(carry, gates_x, wh, bh):
+            h, c = carry
+            gates = gates_x + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+    elif mode == "gru":
+
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+            hr, hz, hn = jnp.split(h @ wh.T + bh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1.0 - z) * n + z * h
+            return (h2,), h2
+
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else (lambda x: jnp.maximum(x, 0))
+
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            h2 = act(gates_x + h @ wh.T + bh)
+            return (h2,), h2
+
+    return step
+
+
+def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
+    """x: (T, N, I) → (T, N, H). Precompute input gates as one big matmul
+    (MXU-friendly), then scan the recurrence."""
+    H = wh.shape[1]
+    gates_x = jnp.einsum("tni,gi->tng", x, wi) + bi
+    step = _cell_step(mode, H)
+    if mode == "lstm":
+        carry0 = (h0, c0)
+    else:
+        carry0 = (h0,)
+
+    def scan_fn(carry, gx):
+        return step(carry, gx, wh, bh)
+
+    if reverse:
+        gates_x = jnp.flip(gates_x, axis=0)
+    carry, ys = jax.lax.scan(scan_fn, carry0, gates_x)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, carry
+
+
+def _rnn_fcompute(attrs, ins, is_train):
+    mode = attrs["mode"]
+    if mode not in _GATES:
+        raise MXNetError("RNN: unknown mode %s" % mode)
+    num_layers = int(attrs["num_layers"])
+    H = int(attrs["state_size"])
+    bidir = bool(attrs.get("bidirectional", False))
+    dirs = 2 if bidir else 1
+    p = float(attrs.get("p", 0.0))
+    state_outputs = bool(attrs.get("state_outputs", False))
+    if mode == "lstm":
+        data, params, hx, cx = ins[:4]
+    else:
+        data, params, hx = ins[:3]
+        cx = None
+    T, N, I = data.shape
+    ws, bs = _unpack_params(params, num_layers, I, H, bidir, mode)
+    x = data
+    h_out, c_out = [], []
+    rng = attrs.get("__rng__")
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            sidx = layer * dirs + d
+            h0 = hx[sidx]
+            c0 = cx[sidx] if cx is not None else None
+            wi, wh = ws[layer][d]
+            bi, bh = bs[layer][d]
+            ys, carry = _run_layer(x, h0, c0, wi, wh, bi, bh, mode, reverse=(d == 1))
+            outs.append(ys)
+            h_out.append(carry[0])
+            if mode == "lstm":
+                c_out.append(carry[1])
+        x = jnp.concatenate(outs, axis=-1) if dirs == 2 else outs[0]
+        if is_train and p > 0 and layer < num_layers - 1 and rng is not None:
+            key = jax.random.fold_in(rng, layer)
+            mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), jnp.zeros_like(x))
+    outputs = [x]
+    if state_outputs:
+        outputs.append(jnp.stack(h_out, axis=0))
+        if mode == "lstm":
+            outputs.append(jnp.stack(c_out, axis=0))
+    return outputs
+
+
+def _rnn_infer(attrs, in_shapes):
+    mode = attrs["mode"]
+    num_layers = int(attrs["num_layers"])
+    H = int(attrs["state_size"])
+    bidir = bool(attrs.get("bidirectional", False))
+    dirs = 2 if bidir else 1
+    state_outputs = bool(attrs.get("state_outputs", False))
+    dshape = in_shapes[0]
+    if dshape is None:
+        raise MXNetError("RNN: data shape required")
+    T, N, I = dshape
+    psize = _rnn_param_size(num_layers, I, H, bidir, mode)
+    sshape = (num_layers * dirs, N, H)
+    ishapes = [tuple(dshape), (psize,), sshape]
+    if mode == "lstm":
+        ishapes.append(sshape)
+    oshapes = [(T, N, H * dirs)]
+    if state_outputs:
+        oshapes.append(sshape)
+        if mode == "lstm":
+            oshapes.append(sshape)
+    return ishapes, oshapes, []
+
+
+_rnn = OpDef(
+    "RNN",
+    _rnn_fcompute,
+    arguments=("data", "parameters", "state", "state_cell"),
+    defaults={
+        "mode": "lstm",
+        "num_layers": 1,
+        "state_size": 0,
+        "bidirectional": False,
+        "p": 0.0,
+        "state_outputs": False,
+        "pkeep_": 1.0,
+        "lstm_q_": False,
+    },
+    infer_shape=_rnn_infer,
+    needs_rng=True,
+)
+_rnn.list_arguments = lambda attrs=None: (
+    ["data", "parameters", "state", "state_cell"]
+    if (attrs or {}).get("mode", "lstm") == "lstm"
+    else ["data", "parameters", "state"]
+)
+
+
+def _rnn_outputs(attrs=None):
+    a = attrs or {}
+    outs = ["output"]
+    if a.get("state_outputs"):
+        outs.append("state")
+        if a.get("mode", "lstm") == "lstm":
+            outs.append("state_cell")
+    return outs
+
+
+_rnn.list_outputs = _rnn_outputs
+register(_rnn)

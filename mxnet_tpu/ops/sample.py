@@ -1,0 +1,120 @@
+"""Random sampling ops.
+
+Parity: reference ``src/operator/tensor/sample_op.cc`` (uniform, normal,
+gamma, exponential, poisson, negative_binomial, generalized_nb). The
+reference draws from a per-device mshadow PRNG owned by the ResourceManager
+(``src/resource.cc``); here each call gets a functional threefry key
+(attrs["__rng__"]) split from the global seed stream in
+:mod:`mxnet_tpu.random` — parity is distributional, not stream-exact
+(SURVEY.md §7 "RNG parity").
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import OpDef, register
+from .utils import as_tuple
+
+
+def _sample_infer(attrs, in_shapes):
+    return [], [as_tuple(attrs.get("shape", (1,)))], []
+
+
+def _sample_type(attrs, in_types):
+    return [], [np_dtype(attrs.get("dtype", "float32"))], []
+
+
+def _register_sampler(name, fn, defaults, aliases=()):
+    def fcompute(attrs, ins, is_train, _fn=fn):
+        key = attrs["__rng__"]
+        shape = as_tuple(attrs.get("shape", (1,)))
+        dt = np_dtype(attrs.get("dtype", "float32"))
+        return [_fn(key, shape, attrs).astype(dt)]
+
+    d = {"shape": (1,), "dtype": "float32"}
+    d.update(defaults)
+    register(
+        OpDef(
+            name,
+            fcompute,
+            arguments=(),
+            defaults=d,
+            infer_shape=_sample_infer,
+            infer_type=_sample_type,
+            needs_rng=True,
+            aliases=aliases,
+        )
+    )
+
+
+_register_sampler(
+    "_sample_uniform",
+    lambda key, shape, a: jax.random.uniform(
+        key, shape, minval=float(a.get("low", 0.0)), maxval=float(a.get("high", 1.0))
+    ),
+    {"low": 0.0, "high": 1.0},
+    aliases=("uniform", "_random_uniform"),
+)
+_register_sampler(
+    "_sample_normal",
+    lambda key, shape, a: jax.random.normal(key, shape) * float(a.get("scale", 1.0))
+    + float(a.get("loc", 0.0)),
+    {"loc": 0.0, "scale": 1.0},
+    aliases=("normal", "_random_normal"),
+)
+_register_sampler(
+    "_sample_gamma",
+    lambda key, shape, a: jax.random.gamma(key, float(a.get("alpha", 1.0)), shape)
+    * float(a.get("beta", 1.0)),
+    {"alpha": 1.0, "beta": 1.0},
+    aliases=("_random_gamma",),
+)
+_register_sampler(
+    "_sample_exponential",
+    lambda key, shape, a: jax.random.exponential(key, shape) / float(a.get("lam", 1.0)),
+    {"lam": 1.0},
+    aliases=("_random_exponential",),
+)
+_register_sampler(
+    "_sample_poisson",
+    lambda key, shape, a: jax.random.poisson(key, float(a.get("lam", 1.0)), shape).astype(
+        jnp.float32
+    ),
+    {"lam": 1.0},
+    aliases=("_random_poisson",),
+)
+
+
+def _neg_binomial(key, shape, a):
+    k = float(a.get("k", 1.0))
+    p = float(a.get("p", 1.0))
+    # NB(k, p) == Poisson(Gamma(k, (1-p)/p))
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam, shape).astype(jnp.float32)
+
+
+_register_sampler(
+    "_sample_negbinomial",
+    _neg_binomial,
+    {"k": 1.0, "p": 1.0},
+    aliases=("_random_negative_binomial",),
+)
+
+
+def _gen_neg_binomial(key, shape, a):
+    mu = float(a.get("mu", 1.0))
+    alpha = float(a.get("alpha", 1.0))
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, 1.0 / alpha, shape) * (mu * alpha)
+    return jax.random.poisson(kp, lam, shape).astype(jnp.float32)
+
+
+_register_sampler(
+    "_sample_gennegbinomial",
+    _gen_neg_binomial,
+    {"mu": 1.0, "alpha": 1.0},
+    aliases=("_random_generalized_negative_binomial",),
+)

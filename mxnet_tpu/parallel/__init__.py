@@ -1,0 +1,21 @@
+"""TPU-native parallelism: meshes, sharded training steps, collectives.
+
+This package is the TPU redesign of the reference's distribution stack
+(SURVEY.md §2.3 / §5.8): DataParallelExecutorGroup + KVStore + ps-lite
+become ONE compiled program over a ``jax.sharding.Mesh`` — gradients sync
+with ``psum`` over ICI inside the step (dist_device_sync ≡ in-XLA
+allreduce), the optimizer state shards ZeRO-style across data-parallel
+peers (the "Automatic Cross-Replica Sharding of Weight Update" recipe from
+PAPERS.md), and model-parallel placement (the reference's ctx_group +
+PlaceDevice pass) becomes PartitionSpec annotations.
+"""
+from .mesh import (
+    make_mesh, barrier, dp_sharding, replicated_sharding, device_count,
+)
+from .train_step import ShardedTrainStep
+from .ring_attention import ring_attention
+
+__all__ = [
+    "make_mesh", "barrier", "dp_sharding", "replicated_sharding",
+    "device_count", "ShardedTrainStep", "ring_attention",
+]

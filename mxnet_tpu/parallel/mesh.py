@@ -1,0 +1,66 @@
+"""Device-mesh utilities.
+
+The reference's device topology handling (per-GPU engine workers, CUDA P2P
+rings in CommDevice, PS key sharding across servers) collapses into one
+``jax.sharding.Mesh``: ICI collectives replace P2P rings, GSPMD replaces
+key sharding. Mesh axes follow the scaling-book convention:
+
+- ``dp``: data parallel (batch dim)
+- ``tp``: tensor parallel (hidden/feature dims)
+- ``pp``: pipeline stages (inter-layer, the reference's ctx_group model
+  parallelism)
+- ``sp``: sequence/context parallel (ring attention)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def device_count():
+    import jax
+
+    return jax.device_count()
+
+
+def make_mesh(dp=None, tp=1, pp=1, sp=1, devices=None):
+    """Create a Mesh with axes (dp, tp, pp, sp). dp defaults to whatever is
+    left after tp*pp*sp."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        assert n % (tp * pp * sp) == 0, (
+            "devices (%d) not divisible by tp*pp*sp (%d)" % (n, tp * pp * sp)
+        )
+        dp = n // (tp * pp * sp)
+    assert dp * tp * pp * sp == n, (
+        "mesh %dx%dx%dx%d != %d devices" % (dp, tp, pp, sp, n)
+    )
+    dev_array = np.asarray(devices).reshape(dp, tp, pp, sp)
+    return Mesh(dev_array, ("dp", "tp", "pp", "sp"))
+
+
+def dp_sharding(mesh):
+    """Batch-sharded NamedSharding (leading axis over dp)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec("dp"))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def barrier(mesh=None):
+    """Cross-device barrier: a tiny psum everyone must reach (the TPU
+    stand-in for ps::Postoffice::Barrier)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones(())
+    jax.block_until_ready(x + 0)

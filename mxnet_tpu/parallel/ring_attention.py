@@ -1,0 +1,105 @@
+"""Ring attention: sequence/context parallelism over the mesh 'sp' axis.
+
+The reference has NO sequence parallelism (SURVEY.md §2.3) — it handles
+long sequences by bucketing + BPTT truncation. For a TPU framework,
+sequence parallelism is first-class: this module implements ring attention
+(blockwise attention with KV blocks rotated around the ring via
+``jax.lax.ppermute`` over ICI), the idiomatic way to train sequences that
+don't fit one chip — the capability the reference approximates with
+model-parallel LSTM placement.
+
+Used inside shard_map with sequence axis sharded over 'sp':
+    out = ring_attention(q, k, v, axis_name='sp')
+Each device holds a [B, T/sp, H, D] shard; after sp steps every query
+block has attended to every KV block, with online softmax accumulation
+(flash-attention style, numerically exact).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, bias=None, scale=1.0):
+    """One (q-block, kv-block) interaction: returns (numerator, denominator,
+    running max) for online softmax. Shapes: q [B,Tq,H,D], k/v [B,Tk,H,D]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Tq,1]
+    p = jnp.exp(s - m)
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    den = jnp.sum(p, axis=-1)  # [B,H,Tq]
+    return num, den, m[..., 0]
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                   q_offset=None):
+    """Exact attention with KV rotation around the `axis_name` ring.
+
+    q, k, v: [B, T_local, H, D] shards (sequence sharded over axis_name).
+    causal: apply causal masking using global positions.
+    Returns [B, T_local, H, D].
+    """
+    n_dev = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if q_offset is None:
+        q_offset = idx * t_local
+
+    def make_bias(kv_idx):
+        if not causal:
+            return None
+        q_pos = q_offset + jnp.arange(t_local)  # [Tq]
+        k_pos = kv_idx * t_local + jnp.arange(t_local)  # [Tk]
+        mask = q_pos[:, None] >= k_pos[None, :]
+        return jnp.where(mask, 0.0, -1e30)[None, None]  # [1,1,Tq,Tk]
+
+    def body(carry, _):
+        (kv_idx, kb, vb, num, den, mx) = carry
+        bias = make_bias(kv_idx)
+        n_i, d_i, m_i = _block_attn(q, kb, vb, bias, scale)
+        # online softmax merge
+        new_m = jnp.maximum(mx, m_i)
+        alpha = jnp.exp(mx - new_m)  # rescale old accumulators
+        beta = jnp.exp(m_i - new_m)
+        num = num * alpha[..., None].transpose(0, 2, 1, 3) + \
+            n_i * beta[..., None].transpose(0, 2, 1, 3)
+        den = den * alpha + d_i * beta
+        # rotate KV block to the next device over ICI
+        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        kv_idx = jax.lax.ppermute(kv_idx, axis_name, perm)
+        return (kv_idx, kb, vb, num, den, new_m), None
+
+    b, t, h, d = q.shape
+    num0 = jnp.zeros((b, t, h, d), q.dtype)
+    den0 = jnp.zeros((b, h, t), q.dtype)
+    m0 = jnp.full((b, h, t), -1e30, q.dtype)
+    carry0 = (idx, k, v, num0, den0, m0)
+    (kv_idx, kb, vb, num, den, mx), _ = jax.lax.scan(
+        body, carry0, None, length=n_dev
+    )
+    den_t = den.transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
+    return num / jnp.maximum(den_t, 1e-30)
+
+
+def sequence_parallel_attention(q, k, v, mesh, causal=True):
+    """Convenience wrapper: shard_map ring_attention over mesh axis 'sp'."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, "sp", None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,129 @@
+"""Profiler API.
+
+Parity: reference ``python/mxnet/profiler.py`` + ``src/engine/profiler.*``
+(chrome trace-event output, SURVEY.md §5.1). TPU-native: per-op device
+timing comes from jax.profiler (XPlane/TensorBoard); this module both
+drives jax.profiler and keeps a host-side chrome-trace of framework-level
+events (forward/backward/update calls), which is what the reference's
+OprExecStat records amounted to.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_state = {
+    "mode": "symbolic",
+    "filename": "profile.json",
+    "running": False,
+    "events": [],
+    "jax_tracing": False,
+    "jax_dir": None,
+}
+_lock = threading.Lock()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Parity MXSetProfilerConfig. mode: 'symbolic' | 'all'."""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """Parity MXSetProfilerState. state: 'run' | 'stop'."""
+    import jax
+
+    if state == "run":
+        _state["running"] = True
+        _state["t0"] = time.time()
+        # device-side trace via jax profiler when a trace dir is configured
+        trace_dir = os.environ.get("MXNET_TPU_JAX_TRACE_DIR")
+        if trace_dir:
+            jax.profiler.start_trace(trace_dir)
+            _state["jax_tracing"] = True
+            _state["jax_dir"] = trace_dir
+    elif state == "stop":
+        _state["running"] = False
+        if _state.get("jax_tracing"):
+            jax.profiler.stop_trace()
+            _state["jax_tracing"] = False
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def record_event(name, begin_us, end_us, category="operator", pid=0):
+    """Host-side event recording hook (OprExecStat equivalent)."""
+    if not _state["running"]:
+        return
+    with _lock:
+        _state["events"].append(
+            {
+                "name": name,
+                "cat": category,
+                "ph": "B",
+                "ts": begin_us,
+                "pid": pid,
+                "tid": threading.get_ident() % 10000,
+            }
+        )
+        _state["events"].append(
+            {
+                "name": name,
+                "cat": category,
+                "ph": "E",
+                "ts": end_us,
+                "pid": pid,
+                "tid": threading.get_ident() % 10000,
+            }
+        )
+
+
+class scope:
+    """Context manager stamping one chrome-trace event."""
+
+    def __init__(self, name, category="operator"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self.t0 = time.time() * 1e6
+        return self
+
+    def __exit__(self, *a):
+        record_event(self.name, self.t0, time.time() * 1e6, self.category)
+
+
+def dump_profile():
+    """Parity MXDumpProfile — writes chrome trace-event JSON."""
+    with _lock:
+        events = list(_state["events"])
+        _state["events"] = []
+    trace = {
+        "traceEvents": [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 0,
+                "args": {"name": "mxnet_tpu host"},
+            }
+        ]
+        + events,
+        "displayTimeUnit": "ms",
+    }
+    with open(_state["filename"], "w") as f:
+        json.dump(trace, f)
+
+
+# jax passthroughs for device-side profiling
+def start_jax_trace(log_dir):
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_jax_trace():
+    import jax
+
+    jax.profiler.stop_trace()

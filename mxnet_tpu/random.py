@@ -1,0 +1,43 @@
+"""Global random seed / key stream.
+
+Parity: reference ``python/mxnet/random.py`` (mx.random.seed) +
+``src/resource.cc`` SeedRandom. The mshadow per-device PRNG becomes a
+functional threefry key stream: every sampling call splits a fresh subkey,
+so imperative sampling is reproducible after ``seed()`` without any mutable
+device state.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+_state = threading.local()
+
+
+def _get_state():
+    if not hasattr(_state, "key"):
+        import jax
+
+        _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+    return _state.key
+
+
+def seed(seed_state: int):
+    """Seed the global sampler stream (parity: mx.random.seed)."""
+    import jax
+
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split a fresh subkey off the global stream."""
+    import jax
+
+    key = _get_state()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+# sampler front-ends (uniform/normal/...) are generated onto this module by
+# mxnet_tpu.ndarray at import; see _init_random_module there.

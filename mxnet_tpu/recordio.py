@@ -1,0 +1,222 @@
+"""RecordIO: record-packed dataset files.
+
+Parity: reference ``python/mxnet/recordio.py`` + dmlc-core's RecordIO
+format (MXRecordIO/MXIndexedRecordIO readers/writers, IRHeader pack/unpack).
+The binary format matches dmlc recordio (magic 0xced7230a, 4-byte-aligned
+records, lrecord encoding) so .rec files made by the reference's im2rec
+are readable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+_KMAGIC_STRUCT = struct.Struct("<II")
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(data):
+    cflag = (data >> 29) & 7
+    length = data & ((1 << 29) - 1)
+    return cflag, length
+
+
+class MXRecordIO(object):
+    """Sequential RecordIO reader/writer (parity recordio.py:17)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.handle.close()
+        self.is_open = False
+
+    def __del__(self):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        data = _KMAGIC_STRUCT.pack(_MAGIC, _encode_lrec(0, len(buf)))
+        self.handle.write(data)
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = _KMAGIC_STRUCT.unpack(header)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic in %s" % self.uri)
+        _, length = _decode_lrec(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with .idx file (parity recordio.py:87)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+IRHeader = struct.Struct("IfQQ")  # flag, label, id, id2
+
+
+class _HeaderTuple(tuple):
+    @property
+    def flag(self):
+        return self[0]
+
+    @property
+    def label(self):
+        return self[1]
+
+    @property
+    def id(self):
+        return self[2]
+
+    @property
+    def id2(self):
+        return self[3]
+
+
+def pack(header, s):
+    """Pack (IRHeader, bytes) into a record payload (parity recordio.py:206)."""
+    flag, label, id_, id2 = header
+    if isinstance(label, (list, tuple, np.ndarray)) and not np.isscalar(label):
+        label = np.asarray(label, dtype=np.float32)
+        hdr = IRHeader.pack(len(label), 0.0, id_, id2)
+        return hdr + label.tobytes() + s
+    return IRHeader.pack(0, float(label), id_, id2) + s
+
+
+def unpack(s):
+    """Unpack a record payload into (IRHeader, bytes)."""
+    flag, label, id_, id2 = IRHeader.unpack(s[: IRHeader.size])
+    s = s[IRHeader.size:]
+    if flag > 0:
+        label = np.frombuffer(s[: flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    return _HeaderTuple((flag, label, id_, id2)), s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, image ndarray) — decodes JPEG/PNG."""
+    header, s = unpack(s)
+    img = _imdecode_np(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array into a record (uses PIL if available)."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError("pack_img requires PIL") from e
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG"
+    Image.fromarray(img).save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def _imdecode_np(buf, iscolor=-1):
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError:
+        try:
+            import cv2
+
+            arr = np.frombuffer(buf, dtype=np.uint8)
+            img = cv2.imdecode(arr, iscolor)
+            return img[:, :, ::-1] if img is not None and img.ndim == 3 else img
+        except ImportError as e:
+            raise MXNetError("image decode requires PIL or cv2") from e
+    img = Image.open(_io.BytesIO(buf))
+    if iscolor == 0:
+        img = img.convert("L")
+    else:
+        img = img.convert("RGB")
+    return np.asarray(img)

@@ -1,0 +1,50 @@
+"""RNN checkpoint helpers.
+
+Parity: reference ``python/mxnet/rnn/rnn.py`` (save/load_rnn_checkpoint
+with fused-cell weight pack/unpack, do_rnn_checkpoint).
+"""
+from __future__ import annotations
+
+from .. import ndarray as nd
+from ..model import load_checkpoint, save_checkpoint
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC"):
+    """Deprecated alias (parity rnn/rnn.py:10)."""
+    return cell.unroll(
+        length, inputs=inputs, begin_state=begin_state,
+        input_prefix=input_prefix, layout=layout
+    )
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """Parity rnn/rnn.py:15 — unpack fused weights before saving."""
+    if isinstance(cells, (list, tuple)):
+        for cell in cells:
+            arg_params = cell.unpack_weights(arg_params)
+    else:
+        arg_params = cells.unpack_weights(arg_params)
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Parity rnn/rnn.py:43."""
+    sym, arg, aux = load_checkpoint(prefix, epoch)
+    if isinstance(cells, (list, tuple)):
+        for cell in cells:
+            arg = cell.pack_weights(arg)
+    else:
+        arg = cells.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback (parity rnn/rnn.py:61)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

@@ -1,0 +1,130 @@
+"""Executor tests (parity: reference test_executor.py — bind/simple_bind,
+grad_req modes, reshape, shared memory)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b
+    x = np.random.rand(3, 3).astype(np.float32)
+    y = np.random.rand(3, 3).astype(np.float32)
+    ga = mx.nd.zeros((3, 3))
+    gb = mx.nd.zeros((3, 3))
+    exe = out.bind(
+        mx.cpu(), {"a": mx.nd.array(x), "b": mx.nd.array(y)},
+        args_grad={"a": ga, "b": gb}
+    )
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x * y)
+    og = np.random.rand(3, 3).astype(np.float32)
+    exe.backward(mx.nd.array(og))
+    assert_almost_equal(ga.asnumpy(), og * y)
+    assert_almost_equal(gb.asnumpy(), og * x)
+
+
+def test_grad_req_add():
+    a = sym.Variable("a")
+    out = a * 2.0
+    x = np.random.rand(2, 2).astype(np.float32)
+    ga = mx.nd.ones((2, 2))
+    exe = out.bind(mx.cpu(), {"a": mx.nd.array(x)}, args_grad={"a": ga},
+                   grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((2, 2)))
+    assert_almost_equal(ga.asnumpy(), 1 + 2 * np.ones((2, 2)))
+
+
+def test_grad_req_null():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a + b
+    ga = mx.nd.zeros((2,))
+    exe = out.bind(
+        mx.cpu(), {"a": mx.nd.ones((2,)), "b": mx.nd.ones((2,))},
+        args_grad={"a": ga}, grad_req={"a": "write", "b": "null"}
+    )
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((2,)))
+    assert_almost_equal(ga.asnumpy(), np.ones(2))
+
+
+def test_simple_bind_shapes():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=6, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(4, 10))
+    assert exe.arg_dict["fc_weight"].shape == (6, 10)
+    assert exe.grad_dict["fc_weight"].shape == (6, 10)
+
+
+def test_forward_kwargs_update():
+    data = sym.Variable("data")
+    out = data * 3.0
+    exe = out.simple_bind(mx.cpu(), data=(2, 2))
+    x = np.random.rand(2, 2).astype(np.float32)
+    exe.forward(is_train=False, data=mx.nd.array(x))
+    assert_almost_equal(exe.outputs[0].asnumpy(), 3 * x)
+
+
+def test_outputs_before_backward():
+    """Reading outputs between forward(train) and backward must give the
+    same values as after backward (deferred-launch correctness)."""
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=3, name="fc")
+    exe = out.simple_bind(mx.cpu(), data=(2, 4))
+    exe.arg_dict["data"][:] = np.random.rand(2, 4).astype(np.float32)
+    exe.arg_dict["fc_weight"][:] = np.random.rand(3, 4).astype(np.float32)
+    exe.forward(is_train=True)
+    before = exe.outputs[0].asnumpy().copy()
+    exe.backward(mx.nd.ones((2, 3)))
+    after = exe.outputs[0].asnumpy()
+    assert_almost_equal(before, after)
+
+
+def test_reshape():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(2, 5))
+    exe.arg_dict["fc_weight"][:] = np.ones((4, 5), np.float32)
+    exe2 = exe.reshape(data=(6, 5))
+    assert exe2.arg_dict["data"].shape == (6, 5)
+    # params shared
+    assert_almost_equal(
+        exe2.arg_dict["fc_weight"].asnumpy(), np.ones((4, 5))
+    )
+
+
+def test_copy_params_from():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(1, 3))
+    w = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+    exe.copy_params_from({"fc_weight": w}, allow_extra_params=True)
+    assert_almost_equal(exe.arg_dict["fc_weight"].asnumpy(), w.asnumpy())
+
+
+def test_multi_output_executor():
+    a = sym.Variable("a")
+    g = sym.Group([a * 2.0, a + 1.0])
+    exe = g.bind(mx.cpu(), {"a": mx.nd.ones((2,))},
+                 args_grad={"a": mx.nd.zeros((2,))})
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), 2 * np.ones(2))
+    assert_almost_equal(exe.outputs[1].asnumpy(), 2 * np.ones(2))
+    exe.backward([mx.nd.ones((2,)), mx.nd.ones((2,))])
+    assert_almost_equal(exe.grad_dict["a"].asnumpy(), 3 * np.ones(2))
+
+
+def test_monitor_callback():
+    collected = []
+    data = sym.Variable("data")
+    out = data * 2.0
+    exe = out.simple_bind(mx.cpu(), data=(2,))
+    exe.set_monitor_callback(lambda name, arr: collected.append(name))
+    exe.forward()
+    assert collected

@@ -1,0 +1,183 @@
+"""NDArray tests (parity: reference tests/python/unittest/test_ndarray.py
+— imperative ops vs numpy, save/load round-trip, views, dtype)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert a.asnumpy().sum() == 0
+    b = mx.nd.ones((2, 2), dtype=np.float64)
+    assert b.dtype == np.float64
+    c = mx.nd.full((2, 2), 7)
+    assert (c.asnumpy() == 7).all()
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+
+
+def test_elementwise_vs_numpy():
+    np.random.seed(0)
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    y = np.random.rand(3, 4).astype(np.float32) + 0.5
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    assert_almost_equal((a + b).asnumpy(), x + y)
+    assert_almost_equal((a - b).asnumpy(), x - y)
+    assert_almost_equal((a * b).asnumpy(), x * y)
+    assert_almost_equal((a / b).asnumpy(), x / y)
+    assert_almost_equal((a ** b).asnumpy(), x ** y, rtol=1e-4)
+    assert_almost_equal((a + 2).asnumpy(), x + 2)
+    assert_almost_equal((2 - a).asnumpy(), 2 - x)
+    assert_almost_equal((2 / a).asnumpy(), 2 / x, rtol=1e-5)
+    assert_almost_equal((-a).asnumpy(), -x)
+
+
+def test_inplace():
+    x = np.ones((2, 3), np.float32)
+    a = mx.nd.array(x)
+    a += 2
+    assert_almost_equal(a.asnumpy(), x + 2)
+    a *= 3
+    assert_almost_equal(a.asnumpy(), (x + 2) * 3)
+    a /= 3
+    a -= 1
+    assert_almost_equal(a.asnumpy(), x + 1)
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert ((a == b).asnumpy() == [0, 1, 0]).all()
+    assert ((a != b).asnumpy() == [1, 0, 1]).all()
+    assert ((a > b).asnumpy() == [0, 0, 1]).all()
+    assert ((a >= 2).asnumpy() == [0, 1, 1]).all()
+    assert ((a < b).asnumpy() == [1, 0, 0]).all()
+
+
+def test_indexing():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = mx.nd.array(x)
+    assert_almost_equal(a[1].asnumpy(), x[1])
+    assert_almost_equal(a[1:3].asnumpy(), x[1:3])
+    a[1] = 0.0
+    x[1] = 0.0
+    assert_almost_equal(a.asnumpy(), x)
+    a[:] = 5.0
+    assert (a.asnumpy() == 5).all()
+    b = mx.nd.zeros((4, 6))
+    b[2:4] = a[0:2]
+    assert (b.asnumpy()[2:4] == 5).all()
+
+
+def test_reshape_transpose():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = mx.nd.array(x)
+    assert_almost_equal(a.reshape((2, 12)).asnumpy(), x.reshape(2, 12))
+    assert_almost_equal(a.T.asnumpy(), x.T)
+    assert_almost_equal(
+        mx.nd.Reshape(a, shape=(-1, 4)).asnumpy(), x.reshape(-1, 4)
+    )
+    # special codes
+    b = mx.nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert mx.nd.Reshape(b, shape=(0, -1)).shape == (2, 12)
+    assert mx.nd.Reshape(b, shape=(-2,)).shape == (2, 3, 4)
+    assert mx.nd.Reshape(b, shape=(-3, 4)).shape == (6, 4)
+    assert mx.nd.Reshape(b, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+
+
+def test_dot():
+    x = np.random.rand(4, 5).astype(np.float32)
+    y = np.random.rand(5, 3).astype(np.float32)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(x), mx.nd.array(y)).asnumpy(), x @ y, rtol=1e-5
+    )
+    bx = np.random.rand(2, 4, 5).astype(np.float32)
+    by = np.random.rand(2, 5, 3).astype(np.float32)
+    assert_almost_equal(
+        mx.nd.batch_dot(mx.nd.array(bx), mx.nd.array(by)).asnumpy(),
+        bx @ by, rtol=1e-5,
+    )
+
+
+def test_reduce():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.sum(a).asnumpy(), x.sum().reshape(()), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a, axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    assert_almost_equal(
+        mx.nd.sum(a, axis=(0, 2), keepdims=True).asnumpy(),
+        x.sum((0, 2), keepdims=True), rtol=1e-5,
+    )
+    assert_almost_equal(mx.nd.max(a, axis=0).asnumpy(), x.max(0))
+    assert_almost_equal(mx.nd.argmax(a, axis=2).asnumpy(), x.argmax(2))
+
+
+def test_broadcast_ops():
+    x = np.random.rand(2, 1, 4).astype(np.float32)
+    y = np.random.rand(1, 3, 4).astype(np.float32)
+    out = mx.nd.broadcast_add(mx.nd.array(x), mx.nd.array(y))
+    assert_almost_equal(out.asnumpy(), x + y)
+    b = mx.nd.broadcast_to(mx.nd.array(x), shape=(2, 5, 4))
+    assert b.shape == (2, 5, 4)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    a = mx.nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = mx.nd.array(np.arange(5, dtype=np.int32))
+    mx.nd.save(fname, [a, b])
+    loaded = mx.nd.load(fname)
+    assert_almost_equal(loaded[0].asnumpy(), a.asnumpy())
+    assert (loaded[1].asnumpy() == b.asnumpy()).all()
+    assert loaded[1].dtype == np.int32
+    mx.nd.save(fname, {"w": a, "b": b})
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+    assert_almost_equal(loaded["w"].asnumpy(), a.asnumpy())
+
+
+def test_astype_copy():
+    a = mx.nd.array(np.arange(4, dtype=np.float32))
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    c = a.copy()
+    c += 1
+    assert_almost_equal(a.asnumpy(), np.arange(4))
+
+
+def test_concatenate():
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(4, 3).astype(np.float32)
+    out = mx.nd.concatenate([mx.nd.array(x), mx.nd.array(y)], axis=0)
+    assert_almost_equal(out.asnumpy(), np.concatenate([x, y]))
+
+
+def test_take_onehot():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    out = mx.nd.take(mx.nd.array(w), mx.nd.array(idx))
+    assert_almost_equal(out.asnumpy(), w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array(idx), depth=10)
+    assert oh.shape == (3, 10)
+    assert (oh.asnumpy().argmax(1) == [1, 3, 5]).all()
+
+
+def test_fused_optimizer_ops():
+    w = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    weight = mx.nd.array(w)
+    grad = mx.nd.array(g)
+    mx.nd.sgd_update(weight, grad, out=weight, lr=0.1, wd=0.0)
+    assert_almost_equal(weight.asnumpy(), w - 0.1 * g, rtol=1e-5)
+    # momentum writes back into mom
+    weight = mx.nd.array(w)
+    mom = mx.nd.zeros(5)
+    mx.nd.sgd_mom_update(weight, grad, mom, out=weight, lr=0.1, momentum=0.9)
+    assert_almost_equal(mom.asnumpy(), -0.1 * g, rtol=1e-5)
+    assert_almost_equal(weight.asnumpy(), w - 0.1 * g, rtol=1e-5)

@@ -1,0 +1,307 @@
+"""Operator tests (parity: reference tests/python/unittest/test_operator.py
+— symbolic forward vs numpy closed forms + finite-difference gradient
+checks via test_utils)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (
+    assert_almost_equal, check_numeric_gradient, check_symbolic_forward,
+    check_symbolic_backward,
+)
+
+
+def test_elemwise_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a + b * 2.0
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(3, 4).astype(np.float32)
+    check_symbolic_forward(out, [x, y], [x + 2 * y])
+    check_symbolic_backward(
+        out, [x, y], [np.ones((3, 4), np.float32)],
+        [np.ones((3, 4)), 2 * np.ones((3, 4))]
+    )
+
+
+def test_unary_ops():
+    x = np.random.rand(4, 3).astype(np.float32) + 0.5
+    data = sym.Variable("data")
+    for name, fn in [
+        ("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+        ("tanh", np.tanh), ("abs", np.abs), ("square", np.square),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+    ]:
+        out = getattr(sym, name)(data)
+        check_symbolic_forward(out, [x], [fn(x)], rtol=1e-4, atol=1e-5)
+
+
+def test_relu_grad():
+    data = sym.Variable("data")
+    out = sym.Activation(data, act_type="relu")
+    x = np.random.randn(5, 5).astype(np.float32)
+    check_symbolic_forward(out, [x], [np.maximum(x, 0)])
+    og = np.random.rand(5, 5).astype(np.float32)
+    check_symbolic_backward(out, [x], [og], [og * (x > 0)])
+
+
+def test_fully_connected():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=7, name="fc")
+    x = np.random.rand(5, 3).astype(np.float32)
+    w = np.random.rand(7, 3).astype(np.float32)
+    b = np.random.rand(7).astype(np.float32)
+    check_symbolic_forward(fc, [x, w, b], [x @ w.T + b], rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(fc, [x, w, b], numeric_eps=1e-2, rtol=0.05)
+
+
+def test_fully_connected_no_bias_flatten():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+    assert fc.list_arguments() == ["data", "fc_weight"]
+    x = np.random.rand(2, 3, 5).astype(np.float32)
+    w = np.random.rand(4, 15).astype(np.float32)
+    check_symbolic_forward(fc, [x, w], [x.reshape(2, -1) @ w.T], rtol=1e-4,
+                           atol=1e-5)
+
+
+def test_convolution_forward():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                           name="conv")
+    x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    w = np.random.rand(2, 1, 3, 3).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    # naive conv reference
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = np.zeros((1, 2, 5, 5), np.float32)
+    for f in range(2):
+        for i in range(5):
+            for j in range(5):
+                expect[0, f, i, j] = (xp[0, 0, i:i + 3, j:j + 3] * w[f, 0]).sum()
+    check_symbolic_forward(conv, [x, w, b], [expect], rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_grad():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=3, name="conv")
+    x = np.random.rand(2, 2, 6, 6).astype(np.float32)
+    w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    check_numeric_gradient(conv, [x, w, b], numeric_eps=1e-2, rtol=0.05)
+
+
+def test_pooling():
+    data = sym.Variable("data")
+    x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+    pool = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expect = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    check_symbolic_forward(pool, [x], [expect])
+    pool_avg = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expect_avg = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_symbolic_forward(pool_avg, [x], [expect_avg], rtol=1e-5)
+    gpool = sym.Pooling(data, global_pool=True, kernel=(1, 1), pool_type="avg")
+    check_symbolic_forward(
+        gpool, [x], [x.mean(axis=(2, 3), keepdims=True)], rtol=1e-5
+    )
+
+
+def test_batchnorm_train_stats():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, fix_gamma=False, eps=1e-5, name="bn")
+    x = np.random.rand(8, 3, 2, 2).astype(np.float32) * 5
+    gamma = np.random.rand(3).astype(np.float32) + 0.5
+    beta = np.random.rand(3).astype(np.float32)
+    exe = bn.simple_bind(mx.cpu(), data=x.shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["bn_gamma"][:] = gamma
+    exe.arg_dict["bn_beta"][:] = beta
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expect = ((x - mean[None, :, None, None]) /
+              np.sqrt(var[None, :, None, None] + 1e-5)
+              * gamma[None, :, None, None] + beta[None, :, None, None])
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-4)
+    # moving stats updated
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert_almost_equal(mm, 0.1 * mean, rtol=1e-3, atol=1e-4)
+
+
+def test_softmax_output_grad():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.SoftmaxOutput(data, label, name="sm")
+    x = np.random.rand(4, 5).astype(np.float32)
+    lbl = np.array([0, 2, 4, 1], np.float32)
+    ex = np.exp(x - x.max(1, keepdims=True))
+    p = ex / ex.sum(1, keepdims=True)
+    check_symbolic_forward(out, {"data": x, "label": lbl}, [p], rtol=1e-4,
+                           atol=1e-5)
+    onehot = np.eye(5, dtype=np.float32)[lbl.astype(int)]
+    check_symbolic_backward(
+        out, {"data": x, "label": lbl}, None,
+        {"data": p - onehot}, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_linear_regression_output():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.LinearRegressionOutput(data, label)
+    x = np.random.rand(4, 3).astype(np.float32)
+    y = np.random.rand(4, 3).astype(np.float32)
+    check_symbolic_forward(out, {"data": x, "label": y}, [x])
+    check_symbolic_backward(
+        out, {"data": x, "label": y}, None,
+        {"data": (x - y) / 3.0}, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_concat_slice_channel():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    cat = sym.Concat(a, b, dim=1)
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(2, 4).astype(np.float32)
+    check_symbolic_forward(cat, [x, y], [np.concatenate([x, y], 1)])
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1)
+    z = np.random.rand(2, 6).astype(np.float32)
+    check_symbolic_forward(parts, [z], [z[:, :3], z[:, 3:]])
+
+
+def test_transpose_swapaxis_slicing():
+    data = sym.Variable("data")
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(sym.transpose(data), [x], [x.T])
+    check_symbolic_forward(
+        sym.transpose(data, axes=(1, 0, 2)), [x], [x.transpose(1, 0, 2)]
+    )
+    check_symbolic_forward(
+        sym.SwapAxis(data, dim1=0, dim2=2), [x], [x.swapaxes(0, 2)]
+    )
+    check_symbolic_forward(
+        sym.slice_axis(data, axis=1, begin=1, end=3), [x], [x[:, 1:3]]
+    )
+    check_symbolic_forward(
+        sym.slice(data, begin=(0, 1, 0), end=(2, 3, 2)), [x], [x[:, 1:3, :2]]
+    )
+
+
+def test_embedding():
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=10, output_dim=4, name="embed")
+    idx = np.array([[1, 2], [3, 4]], np.float32)
+    w = np.random.rand(10, 4).astype(np.float32)
+    check_symbolic_forward(emb, [idx, w], [w[idx.astype(int)]])
+
+
+def test_dropout_eval_identity():
+    data = sym.Variable("data")
+    out = sym.Dropout(data, p=0.5)
+    x = np.random.rand(10, 10).astype(np.float32)
+    check_symbolic_forward(out, [x], [x])
+
+
+def test_dropout_train_scaling():
+    data = sym.Variable("data")
+    out = sym.Dropout(data, p=0.5)
+    x = np.ones((200, 200), np.float32)
+    exe = out.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    y = exe.outputs[0].asnumpy()
+    # kept entries are scaled by 1/keep; mean ≈ 1
+    assert abs(y.mean() - 1.0) < 0.05
+    assert set(np.unique(np.round(y, 3))) <= {0.0, 2.0}
+
+
+def test_block_grad():
+    data = sym.Variable("data")
+    out = sym.BlockGrad(data * 2.0) + data
+    x = np.random.rand(3, 3).astype(np.float32)
+    og = np.ones((3, 3), np.float32)
+    check_symbolic_backward(out, [x], [og], [og])  # only identity path flows
+
+
+def test_leaky_relu_variants():
+    data = sym.Variable("data")
+    x = np.random.randn(4, 4).astype(np.float32)
+    lrelu = sym.LeakyReLU(data, act_type="leaky", slope=0.1)
+    check_symbolic_forward(lrelu, [x], [np.where(x > 0, x, 0.1 * x)])
+    elu = sym.LeakyReLU(data, act_type="elu", slope=1.0)
+    check_symbolic_forward(
+        elu, [x], [np.where(x > 0, x, np.exp(x) - 1)], rtol=1e-4, atol=1e-6
+    )
+
+
+def test_where():
+    cond = sym.Variable("cond")
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    out = sym.where(cond, x, y)
+    c = np.array([[1, 0], [0, 1]], np.float32)
+    a = np.ones((2, 2), np.float32)
+    b = np.zeros((2, 2), np.float32)
+    check_symbolic_forward(
+        out, {"cond": c, "x": a, "y": b}, [np.where(c > 0, a, b)]
+    )
+
+
+def test_sequence_ops():
+    data = sym.Variable("data")
+    x = np.random.rand(4, 3, 2).astype(np.float32)  # (T,N,C)
+    last = sym.SequenceLast(data)
+    check_symbolic_forward(last, [x], [x[-1]])
+    lengths = np.array([2, 3, 4], np.float32)
+    slen = sym.Variable("sequence_length")
+    last2 = sym.SequenceLast(data, slen, use_sequence_length=True)
+    expect = np.stack([x[1, 0], x[2, 1], x[3, 2]])
+    check_symbolic_forward(
+        last2, {"data": x, "sequence_length": lengths}, [expect]
+    )
+    mask = sym.SequenceMask(data, slen, use_sequence_length=True, value=-1.0)
+    expect_m = x.copy()
+    expect_m[2:, 0] = -1
+    expect_m[3:, 1] = -1
+    check_symbolic_forward(
+        mask, {"data": x, "sequence_length": lengths}, [expect_m]
+    )
+
+
+def test_rnn_op_shapes():
+    data = sym.Variable("data")
+    rnn = sym.RNN(data, state_size=8, num_layers=2, mode="lstm",
+                  state_outputs=True, name="rnn")
+    arg_shapes, out_shapes, _ = rnn.infer_shape(data=(5, 3, 10))
+    assert out_shapes[0] == (5, 3, 8)
+    assert out_shapes[1] == (2, 3, 8)
+    assert out_shapes[2] == (2, 3, 8)
+    # gradient check on a tiny LSTM
+    x = np.random.rand(3, 2, 4).astype(np.float32)
+    names = rnn.list_arguments()
+    shapes = dict(zip(names, arg_shapes))
+    check_numeric_gradient(
+        rnn[0], {n: np.random.rand(*s).astype(np.float32) * 0.5
+                 for n, s in zip(names, rnn.infer_shape(data=(3, 2, 4))[0])},
+        numeric_eps=1e-2, rtol=0.1, atol=1e-2,
+    )
+
+
+def test_upsampling_nearest():
+    data = sym.Variable("data")
+    up = sym.UpSampling(data, scale=2, sample_type="nearest")
+    x = np.random.rand(1, 2, 3, 3).astype(np.float32)
+    expect = x.repeat(2, axis=2).repeat(2, axis=3)
+    check_symbolic_forward(up, [x], [expect])
+
+
+def test_smooth_l1():
+    data = sym.Variable("data")
+    out = sym.smooth_l1(data, scalar=1.0)
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    expect = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
+    check_symbolic_forward(out, [x], [expect])

@@ -1,0 +1,126 @@
+"""Symbol composition / JSON / attr tests (parity: reference
+test_symbol.py, test_attr.py, test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_list_arguments_order():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label",
+    ]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(8, 20))
+    assert arg_shapes[1] == (10, 20)
+    assert arg_shapes[3] == (4, 10)
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=5, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None or 0 in (out_shapes[0] or (0,))
+
+
+def test_infer_type():
+    out = _mlp()
+    arg_types, out_types, _ = out.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in arg_types)
+
+
+def test_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net2 = sym.FullyConnected(sym.Variable("data2"), num_hidden=5, name="fc2")
+    composed = net2(data2=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc2_weight" in args
+    assert "data2" not in args
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    g = sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_json_roundtrip(tmp_path):
+    out = _mlp()
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    arg_shapes, out_shapes, _ = out2.infer_shape(data=(8, 20))
+    assert out_shapes == [(8, 4)]
+    f = str(tmp_path / "sym.json")
+    out.save(f)
+    out3 = sym.load(f)
+    assert out3.tojson() == out.tojson()
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        b = sym.FullyConnected(a, num_hidden=3, name="fc")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1"
+
+
+def test_variable_attrs():
+    v = sym.Variable("w", shape=(3, 4), lr_mult=2.0, wd_mult=0.5)
+    assert v.attr("__shape__") == "(3, 4)"
+    assert float(v.attr("__lr_mult__")) == 2.0
+
+
+def test_name_uniqueness():
+    data = sym.Variable("data")
+    f1 = sym.FullyConnected(data, num_hidden=2)
+    f2 = sym.FullyConnected(data, num_hidden=2)
+    assert f1.name != f2.name
+
+
+def test_arithmetic_scalar():
+    a = sym.Variable("a")
+    out = 1.0 + (a * 2.0) - 0.5
+    x = np.random.rand(2, 2).astype(np.float32)
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array(x)})
+    ex.forward()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), 1 + x * 2 - 0.5,
+                               rtol=1e-6)
+
+
+def test_bn_aux_states():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 4, 4))
+    assert aux_shapes == [(3,), (3,)]

@@ -92,7 +92,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
 def sequence_parallel_attention(q, k, v, mesh, causal=True):
     """Convenience wrapper: shard_map ring_attention over mesh axis 'sp'."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, "sp", None, None)
     fn = shard_map(
@@ -100,6 +100,6 @@ def sequence_parallel_attention(q, k, v, mesh, causal=True):
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)

@@ -116,6 +116,8 @@ def attr_repr(value) -> str:
     if value is None:
         return "None"
     if isinstance(value, (list, tuple)):
+        if len(value) == 1:  # "(100,)" — "(100)" would parse back as int
+            return "(" + attr_repr(value[0]) + ",)"
         return "(" + ", ".join(attr_repr(v) for v in value) + ")"
     return str(value)
 

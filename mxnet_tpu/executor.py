@@ -170,11 +170,14 @@ class Executor:
         """For creation ops (_zeros/_ones) with unknown dims in their shape
         attr, resolve concrete shapes via graph-wide inference."""
         nodes = _topo_order([n for n, _ in symbol._outputs])
+        from .ops.utils import as_tuple
+
+        def _shape_attr(n):
+            return as_tuple(n.canon_attrs().get("shape")) or ()
+
         pending = [
             n for n in nodes
-            if (not n.is_variable)
-            and not n.inputs
-            and 0 in tuple(n.canon_attrs().get("shape") or ())
+            if (not n.is_variable) and not n.inputs and 0 in _shape_attr(n)
         ]
         if not pending:
             return {}

@@ -152,6 +152,7 @@ class DataParallelExecutorGroup(object):
             )
         self.data_shapes = data_shapes
         self.label_shapes = label_shapes
+        self._output_shapes_cache = None
         self._collect_arrays()
 
     def reshape(self, data_shapes, label_shapes):
@@ -246,14 +247,22 @@ class DataParallelExecutorGroup(object):
             exec_.forward(is_train=is_train)
 
     def get_output_shapes(self):
-        outputs = self.execs[0].outputs
-        shapes = [out.shape for out in outputs]
-        concat_shapes = []
-        for key, the_shape in zip(self.symbol.list_outputs(), shapes):
-            the_shape = list(the_shape)
-            the_shape[0] = self.batch_size
-            concat_shapes.append((key, tuple(the_shape)))
-        return concat_shapes
+        # static inference, cached per bind (shapes only change on
+        # bind_exec/reshape which reset the cache)
+        if getattr(self, "_output_shapes_cache", None) is None:
+            exe0 = self.execs[0]
+            input_shapes = {
+                name: exe0.arg_dict[name].shape
+                for name, _ in self.data_shapes + (self.label_shapes or [])
+            }
+            _, out_shapes, _ = self.symbol.infer_shape(**input_shapes)
+            concat_shapes = []
+            for key, the_shape in zip(self.symbol.list_outputs(), out_shapes):
+                the_shape = list(the_shape)
+                the_shape[0] = self.batch_size
+                concat_shapes.append((key, tuple(the_shape)))
+            self._output_shapes_cache = concat_shapes
+        return self._output_shapes_cache
 
     def get_outputs(self, merge_multi_context=True):
         outputs = [

@@ -36,10 +36,11 @@ def make_mesh(dp=None, tp=1, pp=1, sp=1, devices=None):
             "devices (%d) not divisible by tp*pp*sp (%d)" % (n, tp * pp * sp)
         )
         dp = n // (tp * pp * sp)
-    assert dp * tp * pp * sp == n, (
-        "mesh %dx%dx%dx%d != %d devices" % (dp, tp, pp, sp, n)
+    need = dp * tp * pp * sp
+    assert need <= n, "mesh %dx%dx%dx%d needs %d devices, have %d" % (
+        dp, tp, pp, sp, need, n
     )
-    dev_array = np.asarray(devices).reshape(dp, tp, pp, sp)
+    dev_array = np.asarray(devices[:need]).reshape(dp, tp, pp, sp)
     return Mesh(dev_array, ("dp", "tp", "pp", "sp"))
 
 

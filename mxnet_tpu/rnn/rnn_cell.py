@@ -424,14 +424,25 @@ class FusedRNNCell(BaseRNNCell):
         return args
 
     def pack_weights(self, args):
+        # NDArray slices are copies (functional buffers), so assemble the
+        # blob by concatenating parts in _slice_weights traversal order.
         args = args.copy()
         w0 = args["%sl0_i2h%s_weight" % (self._prefix, self._gate_names[0])]
         num_input = w0.shape[1]
         total = self._get_param_size(num_input)
-        arr = nd.zeros((total,))
-        for name, tensor in self._slice_weights(arr, num_input, self._num_hidden).items():
-            tensor[:] = args.pop(name)
-        args[self._parameter.name] = arr
+        template = nd.zeros((total,))
+        parts = []
+        for name, tensor in self._slice_weights(
+            template, num_input, self._num_hidden
+        ).items():
+            val = args.pop(name)
+            val = val.asnumpy() if isinstance(val, nd.NDArray) else np.asarray(val)
+            assert tuple(val.shape) == tuple(tensor.shape), (
+                "pack_weights: %s shape %s != expected %s"
+                % (name, val.shape, tensor.shape)
+            )
+            parts.append(val.reshape(-1))
+        args[self._parameter.name] = nd.array(np.concatenate(parts))
         return args
 
     def _get_param_size(self, num_input):

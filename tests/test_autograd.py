@@ -1,0 +1,82 @@
+"""Imperative autograd tests (parity: reference test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_backward():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    gx = nd.zeros(3)
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = x * x
+    ag.backward([y])
+    assert_almost_equal(gx.asnumpy(), 2 * np.array([1, 2, 3], np.float32))
+
+
+def test_chain_rule():
+    x = nd.array(np.random.rand(4).astype(np.float32) + 0.5)
+    gx = nd.zeros(4)
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = nd.exp(x)
+        z = y * x
+    ag.backward([z])
+    xv = x.asnumpy()
+    assert_almost_equal(gx.asnumpy(), np.exp(xv) * (1 + xv), rtol=1e-4)
+
+
+def test_grad_and_loss_decorator():
+    def f(a, b):
+        return a * b
+
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([3.0], np.float32))
+    grads, loss = ag.grad_and_loss(f)(a, b)
+    assert_almost_equal(grads[0].asnumpy(), [3.0])
+    assert_almost_equal(grads[1].asnumpy(), [2.0])
+    assert_almost_equal(loss.asnumpy(), [6.0])
+
+
+def test_out_grads():
+    x = nd.array(np.ones(3, np.float32))
+    gx = nd.zeros(3)
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = x * 2.0
+    ag.backward([y], out_grads=[nd.array(np.array([1.0, 2.0, 3.0], np.float32))])
+    assert_almost_equal(gx.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_grad_add_req():
+    x = nd.array(np.ones(2, np.float32))
+    gx = nd.ones(2)
+    ag.mark_variables([x], [gx], grad_reqs="add")
+    with ag.train_section():
+        y = x * 3.0
+    ag.backward([y])
+    assert_almost_equal(gx.asnumpy(), [4.0, 4.0])
+
+
+def test_constant_input_recording():
+    """Non-NDArray inputs recorded as constants replay correctly."""
+    x = nd.array(np.ones(3, np.float32))
+    gx = nd.zeros(3)
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = nd.elemwise_add(x, np.array([1.0, 2.0, 3.0], np.float32))
+    ag.backward([y])
+    assert_almost_equal(gx.asnumpy(), np.ones(3))
+
+
+def test_training_flag():
+    assert not ag.is_training()
+    with ag.train_section():
+        assert ag.is_training()
+    assert not ag.is_training()
+    with ag.test_section():
+        assert not ag.is_training()

@@ -1,0 +1,134 @@
+"""IO tests (parity: reference test_io.py + test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def test_ndarray_iter_basic():
+    X = np.arange(100).reshape(25, 4).astype("f")
+    y = np.arange(25).astype("f")
+    it = mx.io.NDArrayIter(X, y, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 4)
+    assert batches[2].pad == 5
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard():
+    X = np.zeros((25, 4), "f")
+    it = mx.io.NDArrayIter(X, np.zeros(25, "f"), batch_size=10,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_provide():
+    X = np.zeros((20, 3, 8, 8), "f")
+    it = mx.io.NDArrayIter(X, np.zeros(20, "f"), batch_size=5)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (5, 3, 8, 8)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_resize_iter():
+    X = np.zeros((30, 2), "f")
+    base = mx.io.NDArrayIter(X, np.zeros(30, "f"), batch_size=10)
+    r = mx.io.ResizeIter(base, 7)
+    assert len(list(r)) == 7
+
+
+def test_prefetching_iter():
+    X = np.random.rand(40, 4).astype("f")
+    y = np.arange(40).astype("f")
+    base = mx.io.NDArrayIter(X, y, batch_size=10)
+    pf = mx.io.PrefetchingIter(base)
+    n = 0
+    for batch in pf:
+        assert batch.data[0].shape == (10, 4)
+        n += 1
+    assert n == 4
+    pf.reset()
+    assert len(list(pf)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(20, 3).astype("f")
+    labels = np.arange(20).astype("f")
+    dpath = str(tmp_path / "data.csv")
+    lpath = str(tmp_path / "label.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                       batch_size=5)
+    batches = list(it)
+    assert len(batches) == 4
+    np.testing.assert_allclose(
+        batches[0].data[0].asnumpy(), data[:5], rtol=1e-5
+    )
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        writer.write(b"record%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert reader.read() == b"record%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        writer.write_idx(i, b"rec%d" % i)
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert reader.read_idx(3) == b"rec3"
+    assert reader.read_idx(0) == b"rec0"
+    reader.close()
+
+
+def test_pack_unpack():
+    hdr = (0, 3.5, 7, 0)
+    payload = b"imagebytes"
+    s = recordio.pack(hdr, payload)
+    header, data = recordio.unpack(s)
+    assert header.label == 3.5
+    assert data == payload
+    # multi-label
+    s2 = recordio.pack((0, [1.0, 2.0, 3.0], 7, 0), payload)
+    header2, data2 = recordio.unpack(s2)
+    np.testing.assert_allclose(header2.label, [1, 2, 3])
+    assert data2 == payload
+
+
+def test_mnist_iter(tmp_path):
+    """Synthesize an idx-format MNIST file (reference MNISTIter surface)."""
+    import gzip
+    import struct
+
+    imgs = (np.random.rand(50, 28, 28) * 255).astype(np.uint8)
+    lbls = (np.arange(50) % 10).astype(np.uint8)
+    img_path = str(tmp_path / "images-idx3-ubyte.gz")
+    lbl_path = str(tmp_path / "labels-idx1-ubyte.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 50, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 50))
+        f.write(lbls.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                         shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (10, 1, 28, 28)
+    assert batch.data[0].asnumpy().max() <= 1.0

@@ -1,0 +1,99 @@
+"""KVStore tests (parity: reference test_kvstore.py — single-process
+aggregation, custom updater, per-device value lists)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kv_type="local"):
+    kv = mx.kvstore.create(kv_type)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, nd.ones(SHAPE))
+    val = nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    assert_almost_equal(val.asnumpy(), np.ones(SHAPE))
+
+
+def test_aggregator():
+    """Push a list of per-device values → stored = sum."""
+    kv = init_kv()
+    num_devs = 4
+    vals = [nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, vals)
+    out = [nd.empty(SHAPE) for _ in range(num_devs)]
+    kv.pull(3, out=out)
+    for o in out:
+        assert_almost_equal(o.asnumpy(), num_devs * np.ones(SHAPE))
+    # list of keys
+    kv.push(KEYS, [[nd.ones(SHAPE) * 2] * num_devs] * len(KEYS))
+    outs = [[nd.empty(SHAPE) for _ in range(num_devs)] for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for olist in outs:
+        for o in olist:
+            assert_almost_equal(o.asnumpy(), 2 * num_devs * np.ones(SHAPE))
+
+
+def test_updater():
+    kv = init_kv()
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv._set_updater(updater)
+    kv.push(3, nd.ones(SHAPE))
+    val = nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    assert_almost_equal(val.asnumpy(), 2 * np.ones(SHAPE))
+    kv.push(3, [nd.ones(SHAPE)] * 3)
+    kv.pull(3, out=val)
+    assert_almost_equal(val.asnumpy(), (2 + 6) * np.ones(SHAPE))
+
+
+def test_optimizer_on_kvstore():
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    grad = nd.ones(SHAPE)
+    kv.push(3, grad)
+    w = nd.empty(SHAPE)
+    kv.pull(3, out=w)
+    assert_almost_equal(w.asnumpy(), -0.1 * np.ones(SHAPE), rtol=1e-5)
+
+
+def test_string_keys_stable():
+    kv = mx.kvstore.create("local")
+    kv.init("weight", nd.zeros(SHAPE))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    kv.push("weight", nd.ones(SHAPE))
+    kv.push("weight", nd.ones(SHAPE))
+    w = nd.empty(SHAPE)
+    kv.pull("weight", out=w)
+    # two momentum steps: -0.1, then -0.1*0.9-0.1 accumulated
+    expect = -0.1 + (-0.19)
+    assert_almost_equal(w.asnumpy(), expect * np.ones(SHAPE), rtol=1e-4)
+
+
+def test_rank_and_type():
+    kv = mx.kvstore.create("local")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    assert kv.type == "local"
+    with pytest.raises(mx.MXNetError):
+        mx.kvstore.create("bogus")
+
+
+def test_get_num_dead_node():
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.get_num_dead_node(0) == 0

@@ -1,0 +1,162 @@
+"""Module API tests (parity: reference test_module.py + train smoke of
+tests/python/train/test_mlp.py — short real trainings with accuracy
+thresholds)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import mlp, lenet
+
+
+def _blob_data(n=800, dim=32, classes=4, seed=3):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype("f") * 3
+    X = np.concatenate(
+        [centers[i] + rng.randn(n // classes, dim).astype("f")
+         for i in range(classes)]
+    )
+    y = np.concatenate([np.full(n // classes, i, "f") for i in range(classes)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def test_module_fit_converges():
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    net = mlp(num_classes=4, hidden=(32,))
+    mod = mx.mod.Module(net)
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            num_epoch=3)
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.95, "MLP did not converge: %s" % acc
+
+
+def test_module_predict_shapes():
+    X, y = _blob_data(n=96)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(mlp(num_classes=4, hidden=(16,)))
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (96, 4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _blob_data(n=128)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(mlp(num_classes=4, hidden=(16,)))
+    mod.fit(it, optimizer="sgd", num_epoch=1)
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    score1 = mod.score(it, "acc")[0][1]
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    score2 = mod2.score(it, "acc")[0][1]
+    assert score1 == score2
+
+
+def test_module_multi_device():
+    """Data parallel over 2 virtual CPU devices (reference
+    test_module.py/multi_lenet style)."""
+    X, y = _blob_data(n=256)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(
+        mlp(num_classes=4, hidden=(16,)), context=[mx.cpu(0), mx.cpu(1)]
+    )
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            num_epoch=3, kvstore="local")
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+def test_module_get_set_params():
+    X, y = _blob_data(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(mlp(num_classes=4, hidden=(8,)))
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    arg, aux = mod.get_params()
+    mod2 = mx.mod.Module(mlp(num_classes=4, hidden=(8,)))
+    mod2.bind(it.provide_data, it.provide_label)
+    mod2.init_params()
+    mod2.set_params(arg, aux)
+    arg2, _ = mod2.get_params()
+    for k in arg:
+        np.testing.assert_allclose(arg[k].asnumpy(), arg2[k].asnumpy())
+
+
+def test_module_input_grads():
+    X, y = _blob_data(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(mlp(num_classes=4, hidden=(8,)))
+    mod.bind(it.provide_data, it.provide_label, inputs_need_grad=True)
+    mod.init_params()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (32, 32)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    rng = np.random.RandomState(5)
+    from mxnet_tpu.models.lstm import BucketingLSTMModel
+
+    sentences = []
+    for _ in range(64):
+        L = rng.choice([4, 6])
+        start = rng.randint(0, 8)
+        sentences.append([(start + i) % 8 + 1 for i in range(L)])
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8, buckets=[4, 6],
+                                   invalid_label=0)
+    sym_gen = BucketingLSTMModel(num_layers=1, input_size=9, num_hidden=8,
+                                 num_embed=4, num_label=9)
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=it.default_bucket_key)
+    mod.fit(it, optimizer="adam", optimizer_params={"learning_rate": 0.02},
+            eval_metric=mx.metric.Perplexity(ignore_label=0), num_epoch=2)
+    assert set(mod._buckets.keys()) <= {4, 6}
+    # params shared between buckets
+    m4 = mod._buckets.get(4)
+    m6 = mod._buckets.get(6)
+    if m4 is not None and m6 is not None:
+        assert m4._arg_params is m6._arg_params
+
+
+def test_sequential_module():
+    X, y = _blob_data(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                 name="fc1")
+    net1 = mx.sym.Activation(net1, act_type="relu")
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                 name="fc2")
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=[]))
+    seq.add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
+    seq.bind(it.provide_data, it.provide_label)
+    seq.init_params()
+    seq.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(it))
+    seq.forward(batch)
+    out = seq.get_outputs()[0]
+    assert out.shape == (32, 4)
+    seq.backward()
+    seq.update()
+
+
+def test_fixed_params():
+    X, y = _blob_data(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    net = mlp(num_classes=4, hidden=(8,))
+    mod = mx.mod.Module(net, fixed_param_names=["fc1_weight"])
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    w_before = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    w_after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(w_before, w_after)

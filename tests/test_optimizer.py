@@ -1,0 +1,111 @@
+"""Optimizer tests (parity: reference test_optimizer.py — fused C++ update
+ops vs python reference math)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _np_sgd(w, g, mom, lr, wd, momentum, rescale):
+    g = g * rescale + wd * w
+    if mom is None:
+        return w - lr * g, None
+    mom = momentum * mom - lr * g
+    return w + mom, mom
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w = rng.rand(10).astype(np.float32)
+    g = rng.rand(10).astype(np.float32)
+    sgd = opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.01,
+                     rescale_grad=0.5)
+    weight = nd.array(w)
+    grad = nd.array(g)
+    state = sgd.create_state(0, weight)
+    mom_np = np.zeros(10, np.float32)
+    w_np = w.copy()
+    for _ in range(3):
+        sgd.update(0, weight, grad, state)
+        w_np, mom_np = _np_sgd(w_np, g, mom_np, 0.1, 0.01, 0.9, 0.5)
+    assert_almost_equal(weight.asnumpy(), w_np, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(state.asnumpy(), mom_np, rtol=1e-4, atol=1e-5)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(1)
+    w = rng.rand(6).astype(np.float32)
+    g = rng.rand(6).astype(np.float32)
+    adam = opt.create("adam", learning_rate=0.01, rescale_grad=1.0)
+    weight = nd.array(w)
+    state = adam.create_state(0, weight)
+    m_np = np.zeros(6, np.float32)
+    v_np = np.zeros(6, np.float32)
+    w_np = w.copy()
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 4):
+        adam.update(0, weight, nd.array(g), state)
+        lr_t = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m_np = b1 * m_np + (1 - b1) * g
+        v_np = b2 * v_np + (1 - b2) * g * g
+        w_np = w_np - lr_t * m_np / (np.sqrt(v_np) + eps)
+    assert_almost_equal(weight.asnumpy(), w_np, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop():
+    rng = np.random.RandomState(2)
+    w = rng.rand(4).astype(np.float32)
+    g = rng.rand(4).astype(np.float32)
+    r = opt.create("rmsprop", learning_rate=0.01)
+    weight = nd.array(w)
+    state = r.create_state(0, weight)
+    r.update(0, weight, nd.array(g), state)
+    n_np = (1 - 0.9) * g * g  # gamma1 default 0.9 in reference RMSProp
+    w_np = w - 0.01 * g / np.sqrt(n_np + 1e-8)
+    assert_almost_equal(weight.asnumpy(), w_np, rtol=1e-3, atol=1e-4)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(5) == 1.0
+    assert s(15) == 0.5
+    m = MultiFactorScheduler(step=[5, 10], factor=0.1)
+    m.base_lr = 1.0
+    assert m(3) == 1.0
+    assert abs(m(7) - 0.1) < 1e-12
+    assert abs(m(12) - 0.01) < 1e-12
+
+
+def test_lr_wd_mult():
+    sgd = opt.create("sgd", learning_rate=1.0,
+                     param_idx2name={0: "w_weight", 1: "b_bias"})
+    sgd.set_lr_mult({"w_weight": 0.5})
+    assert sgd._get_lr(0) == 0.5
+    assert sgd._get_lr(1) == 1.0
+    # bias gets wd 0 by default
+    assert sgd._get_wd(1) == 0.0
+
+
+def test_updater_states_roundtrip():
+    sgd = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    up = opt.get_updater(sgd)
+    w = nd.array(np.ones(3, np.float32))
+    up(0, nd.array(np.ones(3, np.float32)), w)
+    states = up.get_states()
+    up2 = opt.get_updater(opt.create("sgd", learning_rate=0.1, momentum=0.9))
+    up2.set_states(states)
+    assert 0 in up2.states
+
+
+def test_clip_gradient():
+    sgd = opt.create("sgd", learning_rate=1.0, clip_gradient=0.1)
+    w = nd.zeros(3)
+    g = nd.array(np.array([10.0, -10.0, 0.05], np.float32))
+    sgd.update(0, w, g, None)
+    assert_almost_equal(w.asnumpy(), [-0.1, 0.1, -0.05], rtol=1e-5)

@@ -1,0 +1,67 @@
+"""Random sampling tests (parity: reference test_random.py — moment checks
++ seed determinism, not stream equality)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_uniform_moments():
+    mx.random.seed(7)
+    x = mx.random.uniform(-2.0, 2.0, shape=(2000,)).asnumpy()
+    assert abs(x.mean()) < 0.1
+    assert x.min() >= -2 and x.max() <= 2
+
+
+def test_normal_moments():
+    mx.random.seed(7)
+    x = mx.random.normal(1.0, 3.0, shape=(5000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.2
+    assert abs(x.std() - 3.0) < 0.2
+
+
+def test_seed_determinism():
+    mx.random.seed(42)
+    a = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    assert not np.array_equal(b, c)
+
+
+def test_out_kwarg_shape():
+    a = nd.zeros((3, 4))
+    mx.random.uniform(0, 1, out=a)
+    assert a.shape == (3, 4)
+    assert a.asnumpy().std() > 0
+
+
+def test_gamma_exponential_poisson():
+    mx.random.seed(0)
+    g = mx.random.gamma(2.0, 2.0, shape=(4000,)).asnumpy()
+    assert abs(g.mean() - 4.0) < 0.4  # mean = alpha*beta
+    e = mx.random.exponential(2.0, shape=(4000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.1  # mean = 1/lam
+    p = mx.random.poisson(3.0, shape=(4000,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.3
+
+
+def test_negative_binomial():
+    mx.random.seed(0)
+    x = mx.random.negative_binomial(k=4, p=0.5, shape=(4000,)).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.5  # mean = k(1-p)/p
+
+
+def test_symbol_random_ops():
+    """Sampling ops inside a graph get per-step keys."""
+    import mxnet_tpu.symbol as sym
+
+    s = sym.uniform(low=0.0, high=1.0, shape=(100,))
+    exe = s.bind(mx.cpu(), {})
+    exe.forward()
+    a = exe.outputs[0].asnumpy().copy()
+    exe.forward()
+    b = exe.outputs[0].asnumpy()
+    assert not np.array_equal(a, b)

@@ -1,0 +1,128 @@
+"""RNN cell tests (parity: reference test_rnn.py — shape contracts and
+fused-vs-unfused consistency)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.rnn import (
+    BidirectionalCell, FusedRNNCell, GRUCell, LSTMCell, RNNCell,
+    SequentialRNNCell, DropoutCell,
+)
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = RNNCell(10, prefix="rnn_")
+    outputs, _ = cell.unroll(3, input_prefix="rnn_")
+    outputs = sym.Group(outputs)
+    args = set(outputs.list_arguments())
+    assert "rnn_i2h_weight" in args and "rnn_h2h_weight" in args
+    _, outs, _ = outputs.infer_shape(
+        rnn_t0_data=(4, 5), rnn_t1_data=(4, 5), rnn_t2_data=(4, 5)
+    )
+    assert outs == [(4, 10)] * 3
+
+
+def test_lstm_cell_unroll():
+    cell = LSTMCell(8, prefix="lstm_")
+    outputs, states = cell.unroll(3, input_prefix="l_")
+    outputs = sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(
+        l_t0_data=(2, 4), l_t1_data=(2, 4), l_t2_data=(2, 4)
+    )
+    assert outs == [(2, 8)] * 3
+    assert len(states) == 2
+
+
+def test_gru_cell_unroll():
+    cell = GRUCell(6, prefix="gru_")
+    outputs, _ = cell.unroll(2, input_prefix="g_")
+    outputs = sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(g_t0_data=(3, 4), g_t1_data=(3, 4))
+    assert outs == [(3, 6)] * 2
+
+
+def test_stack_and_bidirectional():
+    cell = SequentialRNNCell()
+    cell.add(LSTMCell(4, prefix="l0_"))
+    cell.add(LSTMCell(4, prefix="l1_"))
+    outputs, states = cell.unroll(2, input_prefix="s_")
+    outputs = sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(s_t0_data=(2, 3), s_t1_data=(2, 3))
+    assert outs == [(2, 4)] * 2
+    assert len(states) == 4
+
+    bi = BidirectionalCell(LSTMCell(4, prefix="bl_"), LSTMCell(4, prefix="br_"))
+    outputs, _ = bi.unroll(2, input_prefix="b_")
+    outputs = sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(b_t0_data=(2, 3), b_t1_data=(2, 3))
+    assert outs == [(2, 8)] * 2
+
+
+def test_fused_unfused_consistency():
+    """FusedRNNCell (lax.scan RNN op) must match the unfused LSTMCell stack
+    given the same packed weights (reference test_rnn.py core check)."""
+    T, N, I, H = 3, 2, 4, 5
+    fused = FusedRNNCell(H, num_layers=1, mode="lstm", prefix="lstm_",
+                         get_next_state=False)
+    f_out, _ = fused.unroll(T, inputs=sym.Variable("data"), layout="TNC")
+    unfused = fused.unfuse()
+    u_outs, _ = unfused.unroll(
+        T,
+        inputs=list(sym.SliceChannel(
+            sym.Variable("data"), axis=0, num_outputs=T, squeeze_axis=1
+        )),
+    )
+    u_out = sym.Group([sym.expand_dims(o, axis=0) for o in u_outs])
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(T, N, I).astype("f")
+    psize = fused._get_param_size(I)
+    blob = rng.rand(psize).astype("f") * 0.2
+
+    fe = f_out.simple_bind(mx.cpu(), data=(T, N, I))
+    fe.arg_dict["data"][:] = x
+    fe.arg_dict[fused._parameter.name][:] = blob
+    fe.forward()
+    fused_vals = fe.outputs[0].asnumpy()
+
+    # blob → per-gate args → packed per-layer args for the unfused cells
+    args = unfused.pack_weights(
+        fused.unpack_weights({fused._parameter.name: mx.nd.array(blob)})
+    )
+    ue = sym.Group(u_out).simple_bind(mx.cpu(), data=(T, N, I))
+    ue.arg_dict["data"][:] = x
+    matched = 0
+    for name, arr in args.items():
+        if name in ue.arg_dict:
+            ue.arg_dict[name][:] = arr.asnumpy()
+            matched += 1
+    assert matched >= 4, "weight names did not line up: %s vs %s" % (
+        sorted(args), sorted(ue.arg_dict)
+    )
+    ue.forward()
+    unfused_vals = np.concatenate(
+        [o.asnumpy() for o in ue.outputs], axis=0
+    )
+    np.testing.assert_allclose(fused_vals, unfused_vals, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    cell = FusedRNNCell(6, num_layers=2, mode="lstm", prefix="lstm_")
+    psize = cell._get_param_size(4)
+    blob = mx.nd.array(np.random.rand(psize).astype("f"))
+    args = cell.unpack_weights({cell._parameter.name: blob})
+    packed = cell.pack_weights(args)
+    np.testing.assert_allclose(
+        packed[cell._parameter.name].asnumpy(), blob.asnumpy(), rtol=1e-6
+    )
+
+
+def test_dropout_cell():
+    cell = SequentialRNNCell()
+    cell.add(RNNCell(4, prefix="r_"))
+    cell.add(DropoutCell(0.5, prefix="d_"))
+    outputs, _ = cell.unroll(2, input_prefix="x_")
+    g = sym.Group(outputs)
+    _, outs, _ = g.infer_shape(x_t0_data=(2, 3), x_t1_data=(2, 3))
+    assert outs == [(2, 4)] * 2

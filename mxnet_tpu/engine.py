@@ -190,12 +190,24 @@ _ENGINE = None
 
 
 def get():
-    """Engine singleton, type from MXNET_ENGINE_TYPE (engine.cc:13)."""
+    """Engine singleton, type from MXNET_ENGINE_TYPE (engine.cc:13).
+    Default prefers the native C++ engine (mxnet_tpu/src/engine.cc) when
+    the toolchain built it; NaiveEngine remains the synchronous debug
+    fallback exactly as in the reference."""
     global _ENGINE
     if _ENGINE is None:
-        etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+        etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
         if etype == "NaiveEngine":
             _ENGINE = NaiveEngine()
-        else:
+        elif etype == "ThreadedEngine":  # explicit python engine
             _ENGINE = ThreadedEngine()
+        else:
+            try:
+                from .native import NativeEngine
+
+                _ENGINE = NativeEngine(
+                    get_env("MXNET_CPU_WORKER_NTHREADS", 4)
+                )
+            except Exception:
+                _ENGINE = ThreadedEngine()
     return _ENGINE

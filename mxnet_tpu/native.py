@@ -1,0 +1,191 @@
+"""ctypes bindings for the native runtime (libmxtpu.so).
+
+Parity: the reference's C++ runtime tier (engine N1, IO N11). The library
+is built lazily from mxnet_tpu/src with g++ on first use and cached; all
+entry points degrade gracefully to the pure-python implementations when no
+toolchain is available (``available()`` gates the fast path).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libmxtpu.so")
+
+
+def _build():
+    subprocess.run(
+        ["make", "-s"], cwd=_SRC_DIR, check=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_LIB_PATH)
+                < max(
+                    os.path.getmtime(os.path.join(_SRC_DIR, f))
+                    for f in os.listdir(_SRC_DIR)
+                )
+            ):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        # engine
+        lib.engine_create.restype = ctypes.c_void_p
+        lib.engine_create.argtypes = [ctypes.c_int]
+        lib.engine_destroy.argtypes = [ctypes.c_void_p]
+        lib.engine_new_var.restype = ctypes.c_int64
+        lib.engine_new_var.argtypes = [ctypes.c_void_p]
+        lib.engine_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+        lib.engine_wait_for_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.engine_wait_all.argtypes = [ctypes.c_void_p]
+        # recordio
+        lib.recio_open.restype = ctypes.c_void_p
+        lib.recio_open.argtypes = [ctypes.c_char_p]
+        lib.recio_num_records.restype = ctypes.c_int64
+        lib.recio_num_records.argtypes = [ctypes.c_void_p]
+        lib.recio_record.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.recio_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib.recio_close.argtypes = [ctypes.c_void_p]
+        # mnist / csv
+        lib.mnist_read_header.restype = ctypes.c_int
+        lib.mnist_read_header.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.mnist_read_data.restype = ctypes.c_int
+        lib.mnist_read_data.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64
+        ]
+        lib.csv_parse_floats.restype = ctypes.c_int64
+        lib.csv_parse_floats.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+_ENGINE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class NativeEngine:
+    """Native threaded dependency engine (drop-in for engine.ThreadedEngine)."""
+
+    def __init__(self, num_workers=4):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.engine_create(num_workers)
+        self._callbacks = {}  # keep trampolines alive until they run
+        self._cb_lock = threading.Lock()
+        self._cb_id = 0
+
+    def new_variable(self):
+        return self._lib.engine_new_var(self._h)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        with self._cb_lock:
+            cb_id = self._cb_id
+            self._cb_id += 1
+
+        def trampoline(_):
+            try:
+                fn()
+            finally:
+                with self._cb_lock:
+                    self._callbacks.pop(cb_id, None)
+
+        c_cb = _ENGINE_CB(trampoline)
+        with self._cb_lock:
+            self._callbacks[cb_id] = c_cb
+        n_c, n_m = len(const_vars), len(mutable_vars)
+        c_arr = (ctypes.c_int64 * max(n_c, 1))(*const_vars)
+        m_arr = (ctypes.c_int64 * max(n_m, 1))(*mutable_vars)
+        self._lib.engine_push(
+            self._h, ctypes.cast(c_cb, ctypes.c_void_p), None,
+            c_arr, n_c, m_arr, n_m,
+        )
+
+    def wait_for_var(self, var):
+        self._lib.engine_wait_for_var(self._h, var)
+
+    def wait_for_all(self):
+        self._lib.engine_wait_all(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._lib is not None:
+            try:
+                self._lib.engine_destroy(self._h)
+            except Exception:
+                pass
+            self._h = None
+
+
+class NativeRecordReader:
+    """mmap-indexed RecordIO reader (native fast path for .rec files)."""
+
+    def __init__(self, path):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.recio_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open recordio file %s" % path)
+
+    def __len__(self):
+        return self._lib.recio_num_records(self._h)
+
+    def read(self, i) -> bytes:
+        n = ctypes.c_int64()
+        ptr = self._lib.recio_record(self._h, i, ctypes.byref(n))
+        if not ptr or n.value == 0:
+            raise IndexError(i)
+        return ctypes.string_at(ptr, n.value)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.recio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+def csv_read_floats(path, expected):
+    """Parse a CSV of floats natively into a numpy array."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    buf = np.empty(expected, np.float32)
+    n = lib.csv_parse_floats(
+        path.encode(), buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        expected,
+    )
+    if n < 0:
+        raise IOError("cannot parse %s" % path)
+    return buf[:n]

@@ -93,14 +93,26 @@ class MXRecordIO(object):
 
 
 class MXIndexedRecordIO(MXRecordIO):
-    """Random-access RecordIO with .idx file (parity recordio.py:87)."""
+    """Random-access RecordIO with .idx file (parity recordio.py:87).
+    Reads go through the native mmap-indexed reader (src/recordio.cc) when
+    available — the equivalent of the reference's dmlc RecordIO fast path."""
 
     def __init__(self, idx_path, uri, flag, key_type=int):
         self.idx_path = idx_path
         self.idx = {}
         self.keys = []
         self.key_type = key_type
+        self._native = None
         super().__init__(uri, flag)
+        if flag == "r":
+            try:
+                from .native import NativeRecordReader
+
+                self._native = NativeRecordReader(uri)
+                # map key order to native record ordinals
+                self._key_to_ord = {k: i for i, k in enumerate(self.keys)}
+            except Exception:
+                self._native = None
 
     def open(self):
         super().open()
@@ -128,6 +140,8 @@ class MXIndexedRecordIO(MXRecordIO):
         self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx):
+        if self._native is not None and idx in getattr(self, "_key_to_ord", {}):
+            return self._native.read(self._key_to_ord[idx])
         self.seek(idx)
         return self.read()
 

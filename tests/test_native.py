@@ -1,0 +1,67 @@
+"""Native library tests (engine + recordio + parsers from src/*.cc)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import native, recordio
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def test_native_recordio_interop(tmp_path):
+    path = str(tmp_path / "x.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"abc" * (i + 1) for i in range(17)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = native.NativeRecordReader(path)
+    assert len(r) == 17
+    for i, p in enumerate(payloads):
+        assert r.read(i) == p
+    r.close()
+
+
+def test_indexed_recordio_native_fast_path(tmp_path):
+    path = str(tmp_path / "x.rec")
+    idx = str(tmp_path / "x.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(8):
+        w.write_idx(i * 10, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r._native is not None
+    assert r.read_idx(30) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+
+
+def test_csv_parse(tmp_path):
+    path = str(tmp_path / "d.csv")
+    data = np.random.rand(50, 7).astype("f")
+    np.savetxt(path, data, delimiter=",")
+    vals = native.csv_read_floats(path, 50 * 7 + 10)
+    np.testing.assert_allclose(vals.reshape(50, 7), data, rtol=1e-5)
+
+
+def test_mnist_native_header(tmp_path):
+    import ctypes
+    import struct
+
+    path = str(tmp_path / "images-idx3-ubyte")
+    imgs = (np.arange(2 * 4 * 4) % 256).astype(np.uint8).reshape(2, 4, 4)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 2, 4, 4))
+        f.write(imgs.tobytes())
+    lib = native.get_lib()
+    dims = (ctypes.c_int64 * 4)()
+    nd_ = ctypes.c_int()
+    assert lib.mnist_read_header(path.encode(), dims, ctypes.byref(nd_)) == 0
+    assert nd_.value == 3
+    assert list(dims)[:3] == [2, 4, 4]
+    buf = np.empty(2 * 4 * 4, np.uint8)
+    assert lib.mnist_read_data(
+        path.encode(), buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        buf.size,
+    ) == 0
+    np.testing.assert_array_equal(buf.reshape(2, 4, 4), imgs)

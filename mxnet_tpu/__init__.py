@@ -28,6 +28,7 @@ from . import symbol as sym
 from . import symbol as symbol_doc  # reference keeps this alias
 from . import ops
 from . import executor
+from . import operator
 from . import autograd
 from . import random
 from . import random as rnd

@@ -21,6 +21,7 @@ engine — is zero on the hot path.
 from __future__ import annotations
 
 import functools
+import itertools
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +45,12 @@ class _GraphProgram:
     """A symbol lowered to a pure function of (args, aux, rng) — the unit
     that gets jitted. Built once per bind; shared by fwd and fwd+bwd."""
 
+    _uid_counter = itertools.count()
+
     def __init__(self, symbol: Symbol, shape_overrides=None):
+        # monotonic uid (not id(self): CPython recycles ids, which would let
+        # a new program inherit a dead bind's stateful CustomOp instances)
+        self._program_uid = next(_GraphProgram._uid_counter)
         self.symbol = symbol
         # id(node) -> resolved out shape, for creation ops whose attr shape
         # has unknown (0) dims (RNN begin_state zeros)
@@ -82,6 +88,12 @@ class _GraphProgram:
             attrs = node.canon_attrs()
             if id(node) in self.shape_overrides:
                 attrs["shape"] = self.shape_overrides[id(node)]
+            if node.op.name == "Custom":
+                # stateful CustomOp instances live per (bind, node) like the
+                # reference's one-CustomOp-per-bind (custom-inl.h); the host
+                # uses these keys to scope instance caching
+                attrs["__program_id__"] = self._program_uid
+                attrs["__node_name__"] = node.name
             if node.op.needs_rng:
                 if rng is None:
                     raise MXNetError("executor: rng required for %s" % node.name)

@@ -26,22 +26,30 @@ def _build():
 
 
 def get_lib():
-    """Load (building if needed) the native library; None if unavailable."""
+    """Load (building if needed) the native library; None if unavailable.
+    A failed build is cached (sentinel False) so toolchain-less hosts don't
+    re-spawn a failing make on every call."""
     global _LIB
     with _LIB_LOCK:
+        if _LIB is False:
+            return None
         if _LIB is not None:
             return _LIB
         try:
-            if not os.path.exists(_LIB_PATH) or (
-                os.path.getmtime(_LIB_PATH)
+            have_src = os.path.isdir(_SRC_DIR) and os.listdir(_SRC_DIR)
+            if have_src and (
+                not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH)
                 < max(
                     os.path.getmtime(os.path.join(_SRC_DIR, f))
                     for f in os.listdir(_SRC_DIR)
                 )
             ):
                 _build()
+            # a prebuilt .so without src/ (installed layout) loads as-is
             lib = ctypes.CDLL(_LIB_PATH)
         except (OSError, subprocess.CalledProcessError):
+            _LIB = False
             return None
         # engine
         lib.engine_create.restype = ctypes.c_void_p
@@ -65,6 +73,8 @@ def get_lib():
         lib.recio_record.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)
         ]
+        lib.recio_payload_offset.restype = ctypes.c_int64
+        lib.recio_payload_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.recio_close.argtypes = [ctypes.c_void_p]
         # mnist / csv
         lib.mnist_read_header.restype = ctypes.c_int
@@ -99,34 +109,54 @@ class NativeEngine:
         if self._lib is None:
             raise RuntimeError("native library unavailable")
         self._h = self._lib.engine_create(num_workers)
-        self._callbacks = {}  # keep trampolines alive until they run
+        # ONE persistent ffi closure for the engine's whole lifetime; ops are
+        # dispatched by the void* ctx (an id into _pending). A per-push
+        # CFUNCTYPE can never be freed safely from python: the worker thread
+        # is still inside the libffi closure epilogue when the python fn
+        # returns, so any py-side release (even deferred to the next push)
+        # races the C side. The persistent closure sidesteps the lifetime
+        # question entirely.
+        self._pending = {}  # cb_id -> python fn
         self._cb_lock = threading.Lock()
-        self._cb_id = 0
+        self._cb_id = 0  # ids start at 1: c_void_p(0) arrives as None
+
+        def _dispatch(ctx):
+            with self._cb_lock:
+                fn = self._pending.pop(ctx, None)
+            if fn is not None:
+                fn()
+
+        self._c_dispatch = _ENGINE_CB(_dispatch)
 
     def new_variable(self):
         return self._lib.engine_new_var(self._h)
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        from .base import MXNetError
+
+        # reference ThreadedEngine::CheckDuplicate parity: overlapping or
+        # repeated vars would self-deadlock the dependency queues (a write
+        # queued behind this op's own read/write) — reject instead of hang
+        if len(set(mutable_vars)) != len(tuple(mutable_vars)):
+            raise MXNetError("engine.push: duplicate mutable vars")
+        if len(set(const_vars)) != len(tuple(const_vars)):
+            raise MXNetError("engine.push: duplicate const vars")
+        dup = set(const_vars) & set(mutable_vars)
+        if dup:
+            raise MXNetError(
+                "engine.push: vars %s appear in both const_vars and "
+                "mutable_vars" % sorted(dup)
+            )
         with self._cb_lock:
-            cb_id = self._cb_id
             self._cb_id += 1
-
-        def trampoline(_):
-            try:
-                fn()
-            finally:
-                with self._cb_lock:
-                    self._callbacks.pop(cb_id, None)
-
-        c_cb = _ENGINE_CB(trampoline)
-        with self._cb_lock:
-            self._callbacks[cb_id] = c_cb
+            cb_id = self._cb_id
+            self._pending[cb_id] = fn
         n_c, n_m = len(const_vars), len(mutable_vars)
         c_arr = (ctypes.c_int64 * max(n_c, 1))(*const_vars)
         m_arr = (ctypes.c_int64 * max(n_m, 1))(*mutable_vars)
         self._lib.engine_push(
-            self._h, ctypes.cast(c_cb, ctypes.c_void_p), None,
-            c_arr, n_c, m_arr, n_m,
+            self._h, ctypes.cast(self._c_dispatch, ctypes.c_void_p),
+            ctypes.c_void_p(cb_id), c_arr, n_c, m_arr, n_m,
         )
 
     def wait_for_var(self, var):
@@ -161,9 +191,17 @@ class NativeRecordReader:
     def read(self, i) -> bytes:
         n = ctypes.c_int64()
         ptr = self._lib.recio_record(self._h, i, ctypes.byref(n))
-        if not ptr or n.value == 0:
+        if not ptr:
             raise IndexError(i)
+        if n.value == 0:
+            return b""  # zero-length records are valid
         return ctypes.string_at(ptr, n.value)
+
+    def payload_offset(self, i) -> int:
+        off = self._lib.recio_payload_offset(self._h, i)
+        if off < 0:
+            raise IndexError(i)
+        return off
 
     def close(self):
         if getattr(self, "_h", None):

@@ -1,0 +1,502 @@
+"""Custom operator host — python-defined ops inside compiled graphs.
+
+Parity: reference ``src/operator/custom/custom-inl.h:35-104`` (CustomOp runs
+python callbacks on a dedicated worker thread, ``exec_type()==kAsync``) and
+``python/mxnet/operator.py`` (PythonOp:19, NumpyOp:126, NDArrayOp:226,
+CustomOp:396, CustomOpProp:442, register:576). Load-bearing for the RCNN
+workload (SURVEY.md §7: ``rcnn/symbol/proposal.py`` uses
+``mx.symbol.Custom(op_type='proposal_target')``).
+
+TPU-native design: the reference weaves a callback worker thread into its
+dependency engine; here a custom op is staged INTO the jitted XLA program
+via ``jax.pure_callback`` (an opaque host node XLA schedules device<->host
+transfers around — the same overlap role the reference's async worker
+played), and its gradient is a ``jax.custom_vjp`` whose backward is another
+host callback into the user's ``backward()``. The rest of the graph still
+fuses on the MXU; only the custom region round-trips to host.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import OpDef, register as _register_opdef
+
+__all__ = [
+    "CustomOp",
+    "CustomOpProp",
+    "register",
+    "get_registered",
+    "PythonOp",
+    "NumpyOp",
+    "NDArrayOp",
+]
+
+
+class CustomOp(object):
+    """Base class for the operator instance created by a CustomOpProp.
+
+    Parity: reference ``operator.py:396`` — same ``forward/backward/assign``
+    contract; ``in_data``/``out_data`` are NDArrays (host copies here).
+    """
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write src to dst honouring the grad_req (parity operator.py:430)."""
+        if req in ("null", 0):
+            return
+        if req in ("write", "inplace", 1, 2):
+            dst[:] = src
+        elif req in ("add", 3):
+            dst[:] = dst[:] + src
+        else:
+            raise MXNetError("unknown req %r" % (req,))
+
+
+class CustomOpProp(object):
+    """Base class for custom-op metadata (parity operator.py:442).
+
+    Subclass and override; then ``mx.operator.register("name")(MyProp)``
+    and build symbols with ``mx.symbol.Custom(..., op_type="name")``.
+    All constructor kwargs arrive as strings, as in the reference.
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all args and the single output share in_shape[0]."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type and in_type[0] is not None else np.float32
+        completed = [t if x is None else x for x in in_type]
+        return (
+            completed,
+            [t] * len(self.list_outputs()),
+            [t] * len(self.list_auxiliary_states()),
+        )
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError()
+
+
+_custom_registry: dict[str, type] = {}
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under reg_name
+    (parity operator.py:576 / C API MXCustomOpRegister)."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                "register(%s): expected a CustomOpProp subclass" % reg_name
+            )
+        _custom_registry[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_registered(reg_name):
+    cls = _custom_registry.get(reg_name)
+    if cls is None:
+        raise MXNetError(
+            "custom op type %r is not registered (use mx.operator.register)"
+            % (reg_name,)
+        )
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# the "Custom" OpDef: dispatches on attrs["op_type"]
+# ---------------------------------------------------------------------------
+
+_INTERNAL_ATTRS = ("op_type", "__rng__")
+
+
+def _prop_key(attrs):
+    items = tuple(
+        sorted(
+            (k, str(v))
+            for k, v in attrs.items()
+            if k not in _INTERNAL_ATTRS and not k.startswith("__")
+        )
+    )
+    return (attrs["op_type"], items)
+
+
+_prop_cache: dict[tuple, CustomOpProp] = {}
+# (prop key, program id, node name, signature) -> CustomOp; LRU-bounded so
+# long-running bucketing workloads don't accumulate dead executors' instances
+_op_cache: "OrderedDict[tuple, CustomOp]" = __import__(
+    "collections"
+).OrderedDict()
+_OP_CACHE_MAX = 256
+
+
+def _get_prop(attrs) -> CustomOpProp:
+    if "op_type" not in attrs:
+        raise MXNetError("Custom op requires an op_type attr")
+    key = _prop_key(attrs)
+    prop = _prop_cache.get(key)
+    if prop is None:
+        cls = get_registered(attrs["op_type"])
+        kwargs = {
+            k: str(v)
+            for k, v in attrs.items()
+            if k not in _INTERNAL_ATTRS and not k.startswith("__")
+        }
+        prop = cls(**kwargs)
+        _prop_cache[key] = prop
+    return prop
+
+
+def _get_op(attrs, prop, in_shapes, in_dtypes) -> CustomOp:
+    """One CustomOp instance per (bind, node, signature) — the executor
+    stamps ``__program_id__``/``__node_name__`` into attrs so independent
+    executors never share a stateful instance (reference: CustomOp created
+    per bind, custom-inl.h). Imperative calls (no stamp) share per-signature."""
+    key = (
+        _prop_key(attrs),
+        attrs.get("__program_id__"),
+        attrs.get("__node_name__"),
+        tuple(in_shapes),
+        tuple(str(d) for d in in_dtypes),
+    )
+    op = _op_cache.get(key)
+    if op is None:
+        from .context import cpu
+
+        op = prop.create_operator(cpu(), list(in_shapes), list(in_dtypes))
+        _op_cache[key] = op
+        while len(_op_cache) > _OP_CACHE_MAX:
+            _op_cache.popitem(last=False)
+    else:
+        _op_cache.move_to_end(key)
+    return op
+
+
+def _np_dtype(t):
+    return np.dtype(t if t is not None else np.float32)
+
+
+def _custom_fcompute(attrs, inputs, is_train):
+    import jax
+
+    from . import ndarray as nd
+
+    prop = _get_prop(attrs)
+    arg_names = prop.list_arguments()
+    out_names = prop.list_outputs()
+    aux_names = prop.list_auxiliary_states()
+    n_args, n_outs, n_aux = len(arg_names), len(out_names), len(aux_names)
+    if len(inputs) != n_args + n_aux:
+        raise MXNetError(
+            "Custom(%s): expected %d args + %d aux, got %d inputs"
+            % (attrs["op_type"], n_args, n_aux, len(inputs))
+        )
+
+    in_shapes = [tuple(int(d) for d in v.shape) for v in inputs[:n_args]]
+    in_dtypes = [np.dtype(v.dtype) for v in inputs[:n_args]]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_types, _ = prop.infer_type(list(in_dtypes))
+    out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
+    out_dtypes = [_np_dtype(t) for t in out_types]
+    # aux shape/dtype come from the actual bound aux arrays, not inference —
+    # they round-trip through the host unchanged
+    aux_shapes = [tuple(int(d) for d in v.shape) for v in inputs[n_args:]]
+    aux_dtypes = [np.dtype(v.dtype) for v in inputs[n_args:]]
+
+    op = _get_op(attrs, prop, in_shapes, in_dtypes)
+    train_flag = bool(is_train)
+
+    fwd_result_shapes = [
+        jax.ShapeDtypeStruct(s, d) for s, d in zip(out_shapes, out_dtypes)
+    ] + [jax.ShapeDtypeStruct(s, d) for s, d in zip(aux_shapes, aux_dtypes)]
+    bwd_result_shapes = [
+        jax.ShapeDtypeStruct(s, d) for s, d in zip(in_shapes, in_dtypes)
+    ]
+
+    def _host_forward(*flat):
+        # The executor's fused train step recomputes forward inside
+        # forward+backward; memoize on input digest so user forward() runs
+        # ONCE per distinct inputs — keeps outputs and gradients consistent
+        # for stochastic ops (RCNN proposal_target samples rois) and matches
+        # the reference's one-forward-per-step engine scheduling.
+        import hashlib
+
+        h = hashlib.blake2b(str(train_flag).encode(), digest_size=16)
+        for x in flat:
+            h.update(np.asarray(x).tobytes())
+        digest = h.digest()
+        memo = getattr(op, "_mxtpu_fwd_memo", None)
+        if memo is not None and memo[0] == digest:
+            return memo[1]
+        in_data = [nd.array(np.asarray(x)) for x in flat[:n_args]]
+        aux = [nd.array(np.asarray(x)) for x in flat[n_args:]]
+        out_data = [
+            nd.zeros(s, dtype=d) for s, d in zip(out_shapes, out_dtypes)
+        ]
+        op.forward(train_flag, ["write"] * n_outs, in_data, out_data, aux)
+        outs = [
+            np.asarray(o.asnumpy(), dtype=d)
+            for o, d in zip(out_data, out_dtypes)
+        ]
+        outs += [
+            np.asarray(a.asnumpy(), dtype=d) for a, d in zip(aux, aux_dtypes)
+        ]
+        result = tuple(outs)
+        op._mxtpu_fwd_memo = (digest, result)
+        return result
+
+    def _host_backward(ins, outs, cots):
+        in_data = [nd.array(np.asarray(x)) for x in ins[:n_args]]
+        aux = [nd.array(np.asarray(x)) for x in ins[n_args:]]
+        out_data = [nd.array(np.asarray(x)) for x in outs[:n_outs]]
+        out_grad = [nd.array(np.asarray(x)) for x in cots]
+        in_grad = [
+            nd.zeros(s, dtype=d) for s, d in zip(in_shapes, in_dtypes)
+        ]
+        op.backward(
+            ["write"] * n_args, out_grad, in_data, out_data, in_grad, aux
+        )
+        return tuple(
+            np.asarray(g.asnumpy(), dtype=d)
+            for g, d in zip(in_grad, in_dtypes)
+        )
+
+    @jax.custom_vjp
+    def f(*ins):
+        res = jax.pure_callback(_host_forward, tuple(fwd_result_shapes), *ins)
+        return tuple(res)
+
+    def f_fwd(*ins):
+        res = f(*ins)
+        return res, (ins, res)
+
+    def f_bwd(residual, cots):
+        ins, res = residual
+        out_cots = tuple(cots[:n_outs])  # aux cotangents are zeros; dropped
+        gin = jax.pure_callback(
+            _host_backward, tuple(bwd_result_shapes), ins, res, out_cots
+        )
+        gaux = tuple(jax.numpy.zeros_like(a) for a in ins[n_args:])
+        return tuple(gin) + gaux
+
+    f.defvjp(f_fwd, f_bwd)
+    return list(f(*inputs))
+
+
+class _CustomOpDef(OpDef):
+    """OpDef whose arity/inference dispatch to the registered CustomOpProp."""
+
+    def __init__(self):
+        OpDef.__init__(
+            self,
+            "Custom",
+            _custom_fcompute,
+            arguments=("data",),
+            defaults={},
+        )
+
+    def canon_attrs(self, raw_attrs):
+        # reference semantics: kwargs reach CustomOpProp as raw strings —
+        # no dmlc::Parameter parsing for custom ops
+        return {
+            k: v for k, v in (raw_attrs or {}).items() if not k.startswith("__")
+        }
+
+    def num_inputs(self, attrs):
+        return len(_get_prop(attrs).list_arguments())
+
+    def list_arguments(self, attrs=None):
+        if attrs is None or "op_type" not in attrs:
+            return ["data"]
+        return list(_get_prop(attrs).list_arguments())
+
+    def list_outputs(self, attrs=None):
+        if attrs is None or "op_type" not in attrs:
+            return ["output"]
+        return list(_get_prop(attrs).list_outputs())
+
+    def list_auxiliary_states(self, attrs=None):
+        if attrs is None or "op_type" not in attrs:
+            return []
+        return list(_get_prop(attrs).list_auxiliary_states())
+
+    def infer_shape(self, attrs, in_shapes):
+        prop = _get_prop(attrs)
+        in_sh, out_sh, aux_sh = prop.infer_shape(
+            [None if s is None else list(s) for s in in_shapes]
+        )
+        tup = lambda ss: [None if s is None else tuple(s) for s in ss]
+        return tup(in_sh), tup(out_sh), tup(aux_sh)
+
+    def infer_type(self, attrs, in_types):
+        prop = _get_prop(attrs)
+        in_t, out_t, aux_t = prop.infer_type(list(in_types))
+        return (
+            [_np_dtype(t) for t in in_t],
+            [_np_dtype(t) for t in out_t],
+            [_np_dtype(t) for t in aux_t],
+        )
+
+
+_register_opdef(_CustomOpDef())
+
+
+# ---------------------------------------------------------------------------
+# legacy shims: PythonOp / NumpyOp / NDArrayOp (reference operator.py:19-395)
+# ---------------------------------------------------------------------------
+
+
+def _refresh_frontends():
+    """Expose the Custom op through the generated symbol/ndarray namespaces
+    (this module registers its OpDef after those namespaces were built)."""
+    from . import symbol as _sym_mod
+
+    _sym_mod._init_symbol_module()
+    from . import ndarray as _nd_mod
+
+    _nd_mod._init_ndarray_module()
+
+
+_refresh_frontends()
+
+
+class PythonOp(object):
+    """Base of the deprecated pre-CustomOp interface (operator.py:19).
+    ``get_symbol(*args)`` builds a Symbol running this op via the Custom
+    host. Kept for API parity; new code should use CustomOp/CustomOpProp."""
+
+    _seq = [0]
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    # -- user overridables, same contract as the reference ------------------
+    def forward(self, in_data, out_data):
+        raise NotImplementedError()
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError()
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -- shim plumbing ------------------------------------------------------
+    def _make_shim_op(self):
+        """CustomOp adapter calling this PythonOp with numpy arrays."""
+        pyop = self
+
+        class _ShimOp(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                pyop.forward(
+                    in_data=[x.asnumpy() for x in in_data],
+                    out_data=out_data,
+                )
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                pyop.backward(
+                    out_grad=[x.asnumpy() for x in out_grad],
+                    in_data=[x.asnumpy() for x in in_data],
+                    out_data=[x.asnumpy() for x in out_data],
+                    in_grad=in_grad,
+                )
+
+        return _ShimOp()
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym_mod
+
+        pyop = self
+
+        class _ShimProp(CustomOpProp):
+            def __init__(self):
+                CustomOpProp.__init__(self, pyop.need_top_grad())
+
+            def list_arguments(self):
+                return pyop.list_arguments()
+
+            def list_outputs(self):
+                return pyop.list_outputs()
+
+            def infer_shape(self, in_shape):
+                res = pyop.infer_shape(in_shape)
+                if len(res) == 2:
+                    return res[0], res[1], []
+                return res
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                return pyop._make_shim_op()
+
+        PythonOp._seq[0] += 1
+        reg_name = "_pythonop_%s_%d" % (type(self).__name__, PythonOp._seq[0])
+        register(reg_name)(_ShimProp)
+        return sym_mod.Custom(*args, op_type=reg_name, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Numpy-callback op (reference operator.py:126). forward/backward get
+    numpy arrays; outputs are written via ``out_data[i][:] = value`` on the
+    shim's NDArrays, matching the reference's aligned-copy semantics."""
+
+
+class NDArrayOp(PythonOp):
+    """NDArray-callback op (reference operator.py:226). Same registration
+    plumbing as PythonOp; the callbacks receive host NDArrays instead of
+    raw numpy."""
+
+    def _make_shim_op(self):
+        pyop = self
+
+        class _ShimOp(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                pyop.forward(in_data=in_data, out_data=out_data)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                pyop.backward(
+                    out_grad=out_grad,
+                    in_data=in_data,
+                    out_data=out_data,
+                    in_grad=in_grad,
+                )
+
+        return _ShimOp()

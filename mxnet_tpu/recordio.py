@@ -103,16 +103,8 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         self.key_type = key_type
         self._native = None
+        self._key_to_ord = {}
         super().__init__(uri, flag)
-        if flag == "r":
-            try:
-                from .native import NativeRecordReader
-
-                self._native = NativeRecordReader(uri)
-                # map key order to native record ordinals
-                self._key_to_ord = {k: i for i, k in enumerate(self.keys)}
-            except Exception:
-                self._native = None
 
     def open(self):
         super().open()
@@ -125,10 +117,36 @@ class MXIndexedRecordIO(MXRecordIO):
                     key = self.key_type(line[0])
                     self.idx[key] = int(line[1])
                     self.keys.append(key)
+        if not self.writable:
+            try:
+                from .native import NativeRecordReader
+
+                self._native = NativeRecordReader(self.uri)
+                # The .idx file stores record-START byte offsets; the native
+                # reader indexes PAYLOAD offsets (start + 8-byte header).
+                # Match through the offsets — never list position: a sorted
+                # or subset .idx would otherwise silently return the wrong
+                # record.
+                ord_by_payload = {
+                    self._native.payload_offset(i): i
+                    for i in range(len(self._native))
+                }
+                self._key_to_ord = {}
+                for k in self.keys:
+                    o = ord_by_payload.get(self.idx[k] + 8)
+                    if o is not None:
+                        self._key_to_ord[k] = o
+            except Exception:
+                self._native = None
+                self._key_to_ord = {}
 
     def close(self):
         if not self.is_open:
             return
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+        self._key_to_ord = {}
         if self.writable:
             with open(self.idx_path, "w") as fout:
                 for k in self.keys:
@@ -140,7 +158,7 @@ class MXIndexedRecordIO(MXRecordIO):
         self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx):
-        if self._native is not None and idx in getattr(self, "_key_to_ord", {}):
+        if self._native is not None and idx in self._key_to_ord:
             return self._native.read(self._key_to_ord[idx])
         self.seek(idx)
         return self.read()

@@ -86,6 +86,13 @@ const uint8_t* recio_record(RecReader* r, int64_t i, int64_t* out_len) {
   return r->base + r->offsets[i];
 }
 
+// Payload byte offset of record i (record start + 8-byte header), so
+// callers can reconcile external .idx files against physical layout.
+int64_t recio_payload_offset(RecReader* r, int64_t i) {
+  if (i < 0 || static_cast<size_t>(i) >= r->offsets.size()) return -1;
+  return static_cast<int64_t>(r->offsets[i]);
+}
+
 void recio_close(RecReader* r) {
   if (!r) return;
   if (r->base) munmap(const_cast<uint8_t*>(r->base), r->size);
@@ -145,6 +152,9 @@ int mnist_read_data(const char* path, uint8_t* out, int64_t count) {
 // Much faster than numpy.loadtxt for large files.
 // ---------------------------------------------------------------------
 int64_t csv_parse_floats(const char* path, float* out, int64_t capacity) {
+  // Read into a NUL-terminated heap buffer: strtof scans to a terminator,
+  // so parsing straight off an mmap whose size is an exact page multiple
+  // would run past the mapping on a file ending mid-number.
   int fd = ::open(path, O_RDONLY);
   if (fd < 0) return -1;
   struct stat st;
@@ -152,13 +162,26 @@ int64_t csv_parse_floats(const char* path, float* out, int64_t capacity) {
     ::close(fd);
     return -1;
   }
-  void* mem = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
-  if (mem == MAP_FAILED) {
+  char* buf = static_cast<char*>(malloc(st.st_size + 1));
+  if (!buf) {
     ::close(fd);
     return -1;
   }
-  const char* p = static_cast<const char*>(mem);
-  const char* end = p + st.st_size;
+  size_t got = 0;
+  while (got < static_cast<size_t>(st.st_size)) {
+    ssize_t k = ::read(fd, buf + got, st.st_size - got);
+    if (k < 0) {  // I/O error: fail loudly, never return a truncated parse
+      free(buf);
+      ::close(fd);
+      return -1;
+    }
+    if (k == 0) break;  // EOF (file shrank since fstat)
+    got += static_cast<size_t>(k);
+  }
+  ::close(fd);
+  buf[got] = '\0';
+  const char* p = buf;
+  const char* end = buf + got;
   int64_t n = 0;
   while (p < end && n < capacity) {
     char* next = nullptr;
@@ -170,8 +193,7 @@ int64_t csv_parse_floats(const char* path, float* out, int64_t capacity) {
     out[n++] = v;
     p = next;
   }
-  munmap(mem, st.st_size);
-  ::close(fd);
+  free(buf);
   return n;
 }
 

@@ -1,0 +1,147 @@
+"""Custom-op host tests (parity: reference tests exercising
+python/mxnet/operator.py — CustomOp/CustomOpProp/register — and the RCNN
+usage pattern mx.symbol.Custom(op_type=...))."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@mx.operator.register("scale2")
+class Scale2Prop(mx.operator.CustomOpProp):
+    """out = 2*x, grad = 2*gy — exercised both standalone and mid-graph."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Scale2(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0].asnumpy() * 2.0)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                self.assign(in_grad[0], req[0], out_grad[0].asnumpy() * 2.0)
+
+        return Scale2()
+
+
+@mx.operator.register("np_softmax")
+class NpSoftmaxProp(mx.operator.CustomOpProp):
+    """The canonical reference example: a numpy softmax loss custom op."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = [in_shape[0][0]]
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class NpSoftmax(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                y = np.exp(x - x.max(axis=1, keepdims=True))
+                y /= y.sum(axis=1, keepdims=True)
+                self.assign(out_data[0], req[0], y)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                l = in_data[1].asnumpy().astype(np.int64)
+                y = out_data[0].asnumpy().copy()
+                y[np.arange(l.shape[0]), l] -= 1.0
+                self.assign(in_grad[0], req[0], y)
+                self.assign(in_grad[1], req[1], np.zeros_like(in_data[1].asnumpy()))
+
+        return NpSoftmax()
+
+
+def test_custom_forward_backward():
+    data = sym.Variable("data")
+    out = sym.Custom(data, op_type="scale2")
+    x = np.random.rand(3, 4).astype(np.float32)
+    exe = out.simple_bind(mx.cpu(), data=(3, 4))
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), 2 * x, rtol=1e-5)
+    og = np.random.rand(3, 4).astype(np.float32)
+    exe.backward(mx.nd.array(og))
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), 2 * og, rtol=1e-5)
+
+
+def test_custom_mid_graph():
+    """Custom op composed with compiled ops on both sides: the pure_callback
+    host node must thread gradients through the surrounding XLA program."""
+    data = sym.Variable("data")
+    h = data * 3.0
+    h = sym.Custom(h, op_type="scale2")
+    out = h + 1.0
+    x = np.random.rand(2, 5).astype(np.float32)
+    exe = out.simple_bind(mx.cpu(), data=(2, 5))
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), 6 * x + 1, rtol=1e-5)
+    exe.backward(mx.nd.ones((2, 5)))
+    assert_almost_equal(
+        exe.grad_dict["data"].asnumpy(), 6 * np.ones((2, 5)), rtol=1e-5
+    )
+
+
+def test_custom_multi_input_softmax():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.Custom(data, label, op_type="np_softmax", name="sm")
+    assert out.list_arguments() == ["data", "label"]
+    x = np.random.rand(4, 6).astype(np.float32)
+    l = np.array([0, 2, 1, 5], np.float32)
+    exe = out.simple_bind(mx.cpu(), data=(4, 6), label=(4,))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = l
+    exe.forward(is_train=True)
+    ex = np.exp(x - x.max(axis=1, keepdims=True))
+    expect = ex / ex.sum(axis=1, keepdims=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), expect, rtol=1e-4)
+    exe.backward()
+    gref = expect.copy()
+    gref[np.arange(4), l.astype(np.int64)] -= 1.0
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), gref, rtol=1e-4)
+
+
+def test_custom_infer_shape():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.Custom(data, label, op_type="np_softmax")
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(8, 10))
+    assert arg_shapes == [(8, 10), (8,)]
+    assert out_shapes == [(8, 10)]
+
+
+def test_ndarray_op_shim():
+    class Scale3(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0].asnumpy() * 3.0
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0].asnumpy() * 3.0
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    op = Scale3()
+    data = sym.Variable("data")
+    out = op.get_symbol(data)
+    x = np.random.rand(2, 3).astype(np.float32)
+    exe = out.simple_bind(mx.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), 3 * x, rtol=1e-5)
+    exe.backward(mx.nd.ones((2, 3)))
+    assert_almost_equal(
+        exe.grad_dict["data"].asnumpy(), 3 * np.ones((2, 3)), rtol=1e-5
+    )

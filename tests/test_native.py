@@ -65,3 +65,38 @@ def test_mnist_native_header(tmp_path):
         buf.size,
     ) == 0
     np.testing.assert_array_equal(buf.reshape(2, 4, 4), imgs)
+
+
+def test_native_engine_rejects_duplicate_vars():
+    if not native.available():
+        pytest.skip("no native toolchain")
+    eng = native.NativeEngine(num_workers=2)
+    v = eng.new_variable()
+    import mxnet_tpu as mx
+    with pytest.raises(mx.MXNetError):
+        eng.push(lambda: None, const_vars=[v], mutable_vars=[v])
+    eng.wait_for_all()
+
+
+def test_indexed_recordio_sorted_idx(tmp_path):
+    """A key-sorted .idx over records written in a different order must
+    still resolve through byte offsets, not list position."""
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "x.rec")
+    idx = str(tmp_path / "x.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    payloads = {9: b"nine_payload", 3: b"three_pay", 7: b"seven_p"}
+    for k in [9, 3, 7]:  # written out of key order
+        w.write_idx(k, payloads[k])
+    w.close()
+    # rewrite idx key-sorted (valid: offsets still correct)
+    lines = sorted(open(idx).read().splitlines(),
+                   key=lambda l: int(l.split("\t")[0]))
+    with open(idx, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    for k, v in payloads.items():
+        assert r.read_idx(k) == v
+    r.close()
+    assert r._native is None  # close() released the native reader

@@ -1,0 +1,137 @@
+"""Spatial-transform op family tests (parity: reference
+tests/python/unittest/test_operator.py test_bilinear_sampler /
+test_grid_generator / test_correlation; gpu suite test_spatial_transformer)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _identity_theta(batch):
+    return np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (batch, 1))
+
+
+def test_grid_generator_affine_identity():
+    theta = mx.nd.array(_identity_theta(2))
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(4, 5)).asnumpy()
+    assert grid.shape == (2, 2, 4, 5)
+    # identity affine -> grid is just the normalized meshgrid
+    xs = np.linspace(-1, 1, 5)
+    ys = np.linspace(-1, 1, 4)
+    np.testing.assert_allclose(grid[0, 0], np.tile(xs, (4, 1)), atol=1e-5)
+    np.testing.assert_allclose(grid[0, 1], np.tile(ys[:, None], (1, 5)), atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = mx.nd.zeros((1, 2, 3, 4))
+    grid = mx.nd.GridGenerator(flow, transform_type="warp").asnumpy()
+    xs = np.linspace(-1, 1, 4)
+    np.testing.assert_allclose(grid[0, 0], np.tile(xs, (3, 1)), atol=1e-5)
+
+
+def test_bilinear_sampler_identity_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    data = mx.nd.array(x)
+    theta = mx.nd.array(_identity_theta(2))
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(6, 6))
+    out = mx.nd.BilinearSampler(data, grid).asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+    ds, gs = sym.Variable("data"), sym.Variable("grid")
+    s = sym.BilinearSampler(ds, gs)
+    grd = rng.rand(1, 2, 4, 4) * 1.6 - 0.8
+    check_numeric_gradient(
+        s, [rng.randn(1, 2, 5, 5), grd],
+        numeric_eps=1e-3, rtol=5e-2, atol=5e-3,
+    )
+
+
+def test_bilinear_sampler_out_of_bounds_zero():
+    data = mx.nd.ones((1, 1, 4, 4))
+    # grid entirely outside [-1,1] -> zeros
+    grid = mx.nd.array(np.full((1, 2, 2, 2), 3.0, np.float32))
+    out = mx.nd.BilinearSampler(data, grid).asnumpy()
+    np.testing.assert_allclose(out, np.zeros_like(out))
+
+
+def test_spatial_transformer_matches_gridgen_plus_sampler():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    theta_np = np.array(
+        [[0.8, 0.1, 0.05, -0.1, 0.9, -0.05],
+         [1.1, 0.0, 0.2, 0.0, 0.7, 0.1]], np.float32)
+    data, theta = mx.nd.array(x), mx.nd.array(theta_np)
+    st = mx.nd.SpatialTransformer(
+        data, theta, transform_type="affine", sampler_type="bilinear",
+        target_shape=(5, 6)).asnumpy()
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(5, 6))
+    ref = mx.nd.BilinearSampler(data, grid).asnumpy()
+    np.testing.assert_allclose(st, ref, atol=1e-5)
+    assert st.shape == (2, 3, 5, 6)
+
+
+def test_spatial_transformer_grad():
+    rng = np.random.RandomState(2)
+    ds, ls = sym.Variable("data"), sym.Variable("loc")
+    s = sym.SpatialTransformer(ds, ls, target_shape=(4, 4))
+    loc = np.array([[0.9, 0.05, 0.02, -0.03, 0.8, 0.01]])
+    check_numeric_gradient(
+        s, [rng.randn(1, 2, 5, 5), loc],
+        numeric_eps=1e-3, rtol=5e-2, atol=5e-3,
+    )
+
+
+def test_correlation_forward_and_grad():
+    rng = np.random.RandomState(3)
+    d1 = rng.randn(1, 4, 10, 10).astype(np.float32)
+    a = mx.nd.array(d1)
+    out = mx.nd.Correlation(a, a, kernel_size=1, max_displacement=2,
+                            stride1=1, stride2=1, pad_size=2).asnumpy()
+    assert out.shape == (1, 25, 10, 10)
+    # center displacement of self-correlation = mean over channels of x^2
+    np.testing.assert_allclose(
+        out[0, 12], (d1[0] ** 2).mean(axis=0), rtol=1e-4, atol=1e-5)
+
+    s1, s2 = sym.Variable("a"), sym.Variable("b")
+    c = sym.Correlation(s1, s2, kernel_size=3, max_displacement=1,
+                        stride1=1, stride2=1, pad_size=1)
+    check_numeric_gradient(
+        c, [rng.randn(1, 2, 6, 6), rng.randn(1, 2, 6, 6)],
+        numeric_eps=1e-3, rtol=5e-2, atol=5e-3,
+    )
+
+
+def test_correlation_subtract_mode():
+    rng = np.random.RandomState(4)
+    d1 = rng.randn(1, 2, 6, 6).astype(np.float32)
+    a = mx.nd.array(d1)
+    out = mx.nd.Correlation(a, a, kernel_size=1, max_displacement=0,
+                            is_multiply=False).asnumpy()
+    # |x - x| = 0 at zero displacement
+    np.testing.assert_allclose(out, np.zeros_like(out), atol=1e-6)
+
+
+def test_identity_attach_kl_sparse_reg():
+    rng = np.random.RandomState(5)
+    x = sym.Variable("x")
+    y = sym.IdentityAttachKLSparseReg(
+        x, sparseness_target=0.2, penalty=0.01, momentum=0.9)
+    ex = y.simple_bind(mx.cpu(), x=(4, 5), grad_req="write")
+    xin = rng.rand(4, 5).astype(np.float32)
+    ex.arg_dict["x"][:] = xin
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), xin, atol=1e-6)
+    # moving_avg updated toward batch mean per unit
+    avg = ex.aux_dict[y.list_auxiliary_states()[0]].asnumpy()
+    np.testing.assert_allclose(avg, 0.1 * xin.mean(axis=0), rtol=1e-5)
+    ex.backward(mx.nd.ones((4, 5)))
+    g = ex.grad_dict["x"].asnumpy()
+    rho, rho_hat = 0.2, 0.1 * xin.mean(axis=0)
+    expect = 1.0 + 0.01 * (-rho / (rho_hat + 1e-8)
+                           + (1 - rho) / (1 - rho_hat + 1e-8))
+    np.testing.assert_allclose(g, np.tile(expect, (4, 1)), rtol=1e-4)

@@ -2,9 +2,11 @@
 
 Parity: reference ``src/operator/contrib/`` — MultiBoxPrior
 (multibox_prior.cc), MultiBoxTarget (multibox_target.cc), MultiBoxDetection
-(multibox_detection.cc), Proposal (proposal.cc), plus count_sketch/fft
-omitted (CUDA-only curiosities). These are the ops the SSD and Faster-RCNN
-examples are built on (SURVEY.md §7 workload 4).
+(multibox_detection.cc), Proposal (proposal.cc), CTCLoss (the warpctc
+plugin op), fft/ifft (fft.cc — cuFFT wrappers in the reference),
+quantize/dequantize (quantize.cc), count_sketch (count_sketch.cc). These
+are the ops the SSD and Faster-RCNN examples are built on (SURVEY.md §7
+workload 4).
 
 All are implemented as vectorized jnp — box overlap matrices batch onto
 the VPU; no per-anchor loops.
@@ -479,5 +481,261 @@ register(
         arguments=("data", "rois"),
         defaults={"pooled_size": (7, 7), "spatial_scale": 1.0},
         infer_shape=_roi_pooling_infer,
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# CTCLoss — reference plugin/warpctc (the 0.9.5-era CTC op; later versions
+# moved it to src/operator/contrib/ctc_loss). Standard log-space
+# forward-algorithm over the blank-extended label sequence; JAX autodiff
+# through the lax.scan recursion yields the exact CTC gradient that the
+# reference computes with warp-ctc's hand-written backward.
+# Conventions (warp-ctc): blank label = 0; label entries are in
+# [1, alphabet), 0-entries in the label matrix are padding.
+# --------------------------------------------------------------------------
+def _ctc_loss(attrs, ins, is_train):
+    data, label = ins  # [T, B, C] activations (unnormalized), [B, L] labels
+    t_len, b, c = data.shape
+    l_max = label.shape[1]
+    s = 2 * l_max + 1  # blank-extended length
+
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)  # [T,B,C]
+    label = label.astype(jnp.int32)
+    # labels outside [0, alphabet) cannot raise under jit; gathers below are
+    # clamped so they can't poison other samples with NaN, and the affected
+    # sample's loss is forced to +inf — loud and deterministic in training
+    # logs instead of a silent NaN cascade.
+    oob_sample = jnp.any((label < 0) | (label >= c), axis=1)  # [B]
+    label = jnp.clip(label, 0, c - 1)
+    neg_inf = jnp.float32(-1e30)
+
+    # extended sequence l'[b]: blank, l1, blank, l2, ... blank
+    ext = jnp.zeros((b, s), jnp.int32)
+    ext = ext.at[:, 1::2].set(label)  # [B, S]
+    label_len = jnp.sum((label > 0).astype(jnp.int32), axis=1)  # [B]
+    ext_len = 2 * label_len + 1
+
+    # allow skip (s-2 -> s) where ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)))[:, :s]
+    can_skip = (ext != 0) & (ext != ext_prev2)  # [B, S]
+
+    pos = jnp.arange(s)[None, :]  # [1, S]
+    valid = pos < ext_len[:, None]  # [B, S] states inside this label's lattice
+
+    def emit(lp_t):
+        # lp_t [B, C] -> per-state emission log-prob [B, S]
+        return jnp.take_along_axis(lp_t, ext, axis=1)
+
+    alpha0 = jnp.full((b, s), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0, jnp.take_along_axis(
+            logp[0], label[:, :1], axis=1)[:, 0], neg_inf)
+    )
+    alpha0 = jnp.where(valid, alpha0, neg_inf)
+
+    def step(alpha, lp_t):
+        a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=neg_inf)[:, :s]
+        a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=neg_inf)[:, :s]
+        a_prev2 = jnp.where(can_skip, a_prev2, neg_inf)
+        stacked = jnp.stack([alpha, a_prev1, a_prev2], axis=0)
+        merged = jax.nn.logsumexp(stacked, axis=0)
+        alpha_t = merged + emit(lp_t)
+        alpha_t = jnp.where(valid, alpha_t, neg_inf)
+        return alpha_t, None
+
+    alpha_last, _ = jax.lax.scan(step, alpha0, logp[1:])
+
+    # final states: ext_len-1 (last blank) and ext_len-2 (last symbol)
+    idx_last = jnp.clip(ext_len - 1, 0, s - 1)
+    idx_prev = jnp.clip(ext_len - 2, 0, s - 1)
+    a_last = jnp.take_along_axis(alpha_last, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha_last, idx_prev[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_len > 0, a_prev, neg_inf)
+    loss = -jax.nn.logsumexp(jnp.stack([a_last, a_prev]), axis=0)
+    loss = jnp.where(oob_sample, jnp.float32(jnp.inf), loss)
+    return [loss.astype(data.dtype)]
+
+
+def _ctc_loss_infer(attrs, in_shapes):
+    dshape, lshape = in_shapes
+    if dshape is None:
+        raise MXNetError("CTCLoss: data shape required")
+    if len(dshape) != 3:
+        raise MXNetError("CTCLoss: data must be [seq_len, batch, alphabet]")
+    if lshape is None:
+        raise MXNetError("CTCLoss: label shape required")
+    return [tuple(dshape), tuple(lshape)], [(dshape[1],)], []
+
+
+register(
+    OpDef(
+        "CTCLoss",
+        _ctc_loss,
+        arguments=("data", "label"),
+        infer_shape=_ctc_loss_infer,
+        aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# fft / ifft — reference src/operator/contrib/fft.cc (cuFFT C2C). Layout
+# parity: output interleaves real/imag along the last axis
+# [re0, im0, re1, im1, ...]; ifft is UNNORMALIZED like cuFFT (round-trip
+# ifft(fft(x)) == x * n), which the reference tests divide out by hand.
+# --------------------------------------------------------------------------
+def _fft(attrs, ins, is_train):
+    x = ins[0]
+    spec = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)  # [..., d, 2]
+    return [out.reshape(x.shape[:-1] + (2 * x.shape[-1],)).astype(jnp.float32)]
+
+
+def _ifft(attrs, ins, is_train):
+    x = ins[0]
+    d = x.shape[-1] // 2
+    inter = x.reshape(x.shape[:-1] + (d, 2)).astype(jnp.float32)
+    spec = jax.lax.complex(inter[..., 0], inter[..., 1])
+    # cuFFT inverse is unnormalized: scale back up by d
+    out = jnp.fft.ifft(spec, axis=-1).real * d
+    return [out.astype(jnp.float32)]
+
+
+register(
+    OpDef(
+        "fft",
+        _fft,
+        arguments=("data",),
+        defaults={"compute_size": 128},
+        infer_shape=lambda attrs, ins: (
+            [tuple(ins[0])],
+            [tuple(ins[0][:-1]) + (2 * ins[0][-1],)],
+            [],
+        ),
+        aliases=("_contrib_fft",),
+    )
+)
+register(
+    OpDef(
+        "ifft",
+        _ifft,
+        arguments=("data",),
+        defaults={"compute_size": 128},
+        infer_shape=lambda attrs, ins: (
+            [tuple(ins[0])],
+            [tuple(ins[0][:-1]) + (ins[0][-1] // 2,)],
+            [],
+        ),
+        aliases=("_contrib_ifft",),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# quantize / dequantize — reference src/operator/contrib/quantize.cc:
+# affine-map [min_range, max_range] onto the uint8 range and back. On TPU
+# this is the host-side calibration path; actual low-precision matmuls go
+# through bf16/int8 XLA dots instead.
+# --------------------------------------------------------------------------
+def _quantize(attrs, ins, is_train):
+    data, min_r, max_r = ins
+    lo = jnp.min(min_r)
+    hi = jnp.max(max_r)
+    scale = 255.0 / jnp.maximum(hi - lo, 1e-8)
+    q = jnp.clip(jnp.round((data - lo) * scale), 0, 255).astype(jnp.uint8)
+    return [q, lo.reshape(1), hi.reshape(1)]
+
+
+def _dequantize(attrs, ins, is_train):
+    data, min_r, max_r = ins
+    lo = jnp.min(min_r)
+    hi = jnp.max(max_r)
+    scale = jnp.maximum(hi - lo, 1e-8) / 255.0
+    return [data.astype(jnp.float32) * scale + lo]
+
+
+def _quantize_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    return [tuple(d), (1,), (1,)], [tuple(d), (1,), (1,)], []
+
+
+register(
+    OpDef(
+        "quantize",
+        _quantize,
+        arguments=("data", "min_range", "max_range"),
+        outputs=("output", "min_output", "max_output"),
+        infer_shape=_quantize_infer,
+        infer_type=lambda attrs, in_types: (
+            [np.float32, np.float32, np.float32],
+            [np.uint8, np.float32, np.float32],
+            [],
+        ),
+        aliases=("_contrib_quantize",),
+    )
+)
+register(
+    OpDef(
+        "dequantize",
+        _dequantize,
+        arguments=("data", "min_range", "max_range"),
+        infer_shape=lambda attrs, ins: (
+            [tuple(ins[0]), (1,), (1,)],
+            [tuple(ins[0])],
+            [],
+        ),
+        infer_type=lambda attrs, in_types: (
+            [np.uint8, np.float32, np.float32],
+            [np.float32],
+            [],
+        ),
+        aliases=("_contrib_dequantize",),
+    )
+)
+
+
+# --------------------------------------------------------------------------
+# count_sketch — reference src/operator/contrib/count_sketch.cc (compact
+# bilinear pooling). out[n, h[i]] += s[i] * data[n, i]; expressed as one
+# XLA scatter-add, whose transpose (gather) gives the backward pass the
+# reference hand-codes.
+# --------------------------------------------------------------------------
+def _count_sketch_dim(attrs):
+    out_dim = int(attrs.get("out_dim", 0))
+    if out_dim <= 0:
+        raise MXNetError("count_sketch: out_dim is required and must be > 0")
+    return out_dim
+
+
+def _count_sketch(attrs, ins, is_train):
+    data, h, sgn = ins
+    out_dim = _count_sketch_dim(attrs)
+    idx = h.reshape(-1).astype(jnp.int32)  # [in_dim]
+    signs = sgn.reshape(-1).astype(data.dtype)
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return [out.at[..., idx].add(data * signs)]
+
+
+def _count_sketch_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    out_dim = _count_sketch_dim(attrs)
+    in_dim = d[-1]
+    return (
+        [tuple(d), (1, in_dim), (1, in_dim)],
+        [tuple(d[:-1]) + (out_dim,)],
+        [],
+    )
+
+
+register(
+    OpDef(
+        "count_sketch",
+        _count_sketch,
+        arguments=("data", "h", "s"),
+        defaults={"out_dim": 0, "processing_batch_size": 32},
+        infer_shape=_count_sketch_infer,
+        aliases=("_contrib_count_sketch",),
     )
 )

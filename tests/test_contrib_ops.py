@@ -1,0 +1,106 @@
+"""Contrib op tests: CTCLoss, fft/ifft, quantize/dequantize, count_sketch
+(parity: reference src/operator/contrib/ + warpctc plugin tests)."""
+import itertools
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.contrib import ndarray as cnd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _brute_force_ctc(logits, label):
+    """-log P(label | logits) by enumerating all alignment paths (tiny T)."""
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(axis=1, keepdims=True))
+    p = p / p.sum(axis=1, keepdims=True)
+    target = [l for l in label if l > 0]
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != 0:
+                out.append(s)
+            prev = s
+        return out
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == target:
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    return -np.log(total)
+
+
+def test_ctc_loss_vs_brute_force():
+    rng = np.random.RandomState(0)
+    T, B, C = 4, 2, 3
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2], [2, 0]], np.float32)  # second has length 1
+    loss = cnd.CTCLoss(mx.nd.array(logits), mx.nd.array(labels)).asnumpy()
+    for b in range(B):
+        expect = _brute_force_ctc(logits[:, b], labels[b].astype(int))
+        np.testing.assert_allclose(loss[b], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_grad_and_symbol():
+    rng = np.random.RandomState(1)
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    loss = sym.Symbol.__class__  # noqa — namespace sanity
+    from mxnet_tpu.contrib import symbol as csym
+    out = csym.CTCLoss(data, label)
+    x = rng.randn(5, 2, 4).astype(np.float32)
+    lab = np.array([[1, 3], [2, 0]], np.float32)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=x.shape, label=lab.shape)
+    assert out_shapes[0] == (2,)
+    check_numeric_gradient(
+        out, {"data": x, "label": lab}, grad_nodes=["data"],
+        numeric_eps=1e-2, rtol=0.1, atol=1e-2,
+    )
+
+
+def test_fft_ifft_round_trip():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 8).astype(np.float32)
+    f = cnd.fft(mx.nd.array(x)).asnumpy()
+    assert f.shape == (3, 16)
+    # interleaved layout matches numpy fft
+    spec = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], spec.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], spec.imag, rtol=1e-4, atol=1e-4)
+    # unnormalized inverse (cuFFT semantics): ifft(fft(x)) == x * n
+    back = cnd.ifft(mx.nd.array(f)).asnumpy()
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_quantize_dequantize_round_trip():
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+    lo = mx.nd.array([-1.0])
+    hi = mx.nd.array([1.0])
+    q, qlo, qhi = cnd.quantize(mx.nd.array(x), lo, hi)
+    assert q.asnumpy().dtype == np.uint8
+    assert float(qlo.asnumpy()[0]) == -1.0
+    back = cnd.dequantize(q, qlo, qhi).asnumpy()
+    # 8-bit quantization error bound: half a step
+    assert np.abs(back - x).max() <= (2.0 / 255.0)
+
+
+def test_count_sketch():
+    rng = np.random.RandomState(4)
+    n, in_dim, out_dim = 3, 10, 6
+    x = rng.randn(n, in_dim).astype(np.float32)
+    h = rng.randint(0, out_dim, (1, in_dim)).astype(np.float32)
+    s = (rng.randint(0, 2, (1, in_dim)) * 2 - 1).astype(np.float32)
+    out = cnd.count_sketch(
+        mx.nd.array(x), mx.nd.array(h), mx.nd.array(s), out_dim=out_dim
+    ).asnumpy()
+    expect = np.zeros((n, out_dim), np.float32)
+    for i in range(in_dim):
+        expect[:, int(h[0, i])] += s[0, i] * x[:, i]
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)

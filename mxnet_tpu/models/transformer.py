@@ -61,6 +61,15 @@ def transformer_lm(vocab=32000, d_model=512, n_heads=8, n_layers=4,
             from ..parallel.ring_attention import sequence_parallel_attention
 
             o = sequence_parallel_attention(q, k, v, mesh, causal=True)
+        elif mesh is None and jax.default_backend() == "tpu" and T >= 128:
+            # pallas_call has no GSPMD partition rules: only take the flash
+            # path when not under a sharded mesh (the sp>1 ring path above
+            # composes sharding via shard_map instead)
+            # Pallas flash kernel: O(T·block) memory instead of the
+            # materialized [B,H,T,T] score tensor
+            from ..ops.pallas_kernels import flash_attention
+
+            o = flash_attention(q, k, v, causal=True)
         else:
             scale = 1.0 / np.sqrt(head_dim)
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

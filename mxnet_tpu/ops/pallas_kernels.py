@@ -1,0 +1,410 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference reaches for hand-written CUDA / cuDNN where the stock ops
+are too slow (SURVEY.md §2 N6 cudnn_*-inl.h, N18 mshadow). The TPU-native
+equivalent is Pallas: kernels that XLA cannot produce from jnp alone
+because they need explicit on-chip (VMEM) accumulation patterns. The
+flagship here is flash attention — blockwise online-softmax attention
+whose VMEM working set is O(block²+block·D) per grid step (the K/V axis
+is walked by the innermost grid dimension, not loaded whole), forward and
+backward both as MXU-tiled kernels.
+
+Everything degrades gracefully off-TPU: ``interpret=True`` runs the same
+kernels through the Pallas interpreter (tests), and callers can always
+use the pure-jnp reference path (``reference_attention``).
+
+Layout convention matches ``parallel/ring_attention``: [B, T, H, D].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    rem = size % mult
+    if rem == 0:
+        return x, size
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mult - rem)
+    return jnp.pad(x, pad), size
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid (B*H, nq, nk), k innermost. The output block index
+# map ignores the k dimension, so Mosaic keeps o_ref resident in VMEM
+# while the k loop accumulates into scratch; only one (block_q, block_k)
+# tile of each operand is on-chip at a time.
+# ---------------------------------------------------------------------------
+
+def _causal_block_live(qi, ki, block_q, block_k):
+    """Whether k block ki intersects the causal triangle of q block qi."""
+    return ki * jnp.int32(block_k) <= qi * jnp.int32(block_q) + jnp.int32(
+        block_q - 1
+    )
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_s, l_s,
+                *, block_q, block_k, t_real, scale, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, jnp.float32(_NEG_INF))
+        l_s[:] = jnp.zeros_like(l_s)
+
+    live = True
+    if causal:
+        live = _causal_block_live(qi, ki, block_q, block_k)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, D]
+        k_blk = k_ref[0].astype(jnp.float32)  # [bk, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < jnp.int32(t_real)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, jnp.float32(_NEG_INF))
+
+        m_prev = m_s[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_s[:, 0] = l_s[:, 0] * alpha + jnp.sum(p, axis=1)
+        m_s[:, 0] = m_cur
+        acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l_fin = l_s[:, 0]
+        safe_l = jnp.where(l_fin > 0, l_fin, jnp.float32(1.0))
+        o_ref[0] = (acc[:] / safe_l[:, None]).astype(o_ref.dtype)
+        # logsumexp residual for backward
+        l_ref[0, :, 0] = (m_s[:, 0] + jnp.log(safe_l)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels. dq: grid (bh, nq, nk); dkv: grid (bh, nk, nq).
+# dS = P * (dP - delta), P = exp(S - L), dP = dO V^T,
+# delta_i = sum_d dO_id * O_id.
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref,
+                   dq_acc, *, block_q, block_k, t_real, scale, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = _causal_block_live(qi, ki, block_q, block_k)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = l_ref[0, :, 0]
+        delta = d_ref[0, :, 0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.float32(scale) * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < jnp.int32(t_real)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), jnp.float32(0.0))
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = (jnp.float32(scale) * dq_acc[:]).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+                    t_real, scale, causal):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = True
+    if causal:
+        live = _causal_block_live(qi, ki, block_q, block_k)
+
+    @pl.when(live)
+    def _():
+        k_blk = k_ref[0].astype(jnp.float32)  # [bk, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)  # [bq, D]
+        do = do_ref[0].astype(jnp.float32)
+        lse = l_ref[0, :, 0]
+        delta = d_ref[0, :, 0]
+        s = jnp.float32(scale) * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < jnp.int32(t_real)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), jnp.float32(0.0))
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = (jnp.float32(scale) * dk_acc[:]).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side wrappers
+# ---------------------------------------------------------------------------
+
+def _fwd_call(q3, k3, v3, t_real, scale, causal, block_q, block_k,
+              interpret):
+    bh, t_pad, d = q3.shape
+    nq = t_pad // block_q
+    nk = t_pad // block_k
+    kern = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, t_real=t_real,
+        scale=scale, causal=causal,
+    )
+    # trace under 32-bit mode: the framework enables jax_enable_x64 globally
+    # (reference float64 NDArray parity) but Mosaic kernels must stay 32-bit
+    with jax.enable_x64(False):
+        out, lse = pl.pallas_call(
+            kern,
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype),
+                jax.ShapeDtypeStruct((bh, t_pad, 1), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q3, k3, v3)
+    return out, lse
+
+
+def _bwd_call(q3, k3, v3, do3, lse, delta, t_real, scale, causal,
+              block_q, block_k, interpret):
+    bh, t_pad, d = q3.shape
+    nq = t_pad // block_q
+    nk = t_pad // block_k
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                t_real=t_real, scale=scale, causal=causal,
+            ),
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda b, i, j: (b, i, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                t_real=t_real, scale=scale, causal=causal,
+            ),
+            grid=(bh, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype),
+                jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q3, k3, v3, t_real, scale, causal, block_q, block_k):
+    interp = _use_interpret()
+    out, _ = _fwd_call(q3, k3, v3, t_real, scale, causal, block_q,
+                       block_k, interp)
+    return out
+
+
+def _flash_fwd(q3, k3, v3, t_real, scale, causal, block_q, block_k):
+    interp = _use_interpret()
+    out, lse = _fwd_call(q3, k3, v3, t_real, scale, causal, block_q,
+                         block_k, interp)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_bwd(t_real, scale, causal, block_q, block_k, res, g):
+    q3, k3, v3, out, lse = res
+    interp = _use_interpret()
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # [BH, T, 1]
+    dq, dk, dv = _bwd_call(
+        q3, k3, v3, g.astype(q3.dtype), lse, delta, t_real, scale,
+        causal, block_q, block_k, interp,
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128):
+    """Blockwise (flash) attention. q/k/v: [B, T, H, D] -> [B, T, H, D].
+
+    Pallas MXU kernels on TPU; the same kernels run under the Pallas
+    interpreter elsewhere so tests don't need hardware. The TPU-native
+    replacement for what the reference delegates to cuDNN fused kernels
+    (cudnn_rnn-inl.h being the closest 2017 analog of a fused
+    sequence kernel).
+
+    NOTE: pallas_call has no GSPMD partitioning rules — inside pjit over a
+    sharded mesh, wrap calls in shard_map (see parallel/ring_attention for
+    the sp-sharded composition) or keep attention inputs replicated.
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    blk = min(block_q, block_k)
+    if t < blk:
+        block_q = block_k = max(8, 1 << (t - 1).bit_length())
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    mult = int(np.lcm(block_q, block_k))
+    q3, _ = _pad_to(q3, 1, mult)
+    k3, _ = _pad_to(k3, 1, mult)
+    v3, _ = _pad_to(v3, 1, mult)
+    out = _flash(q3, k3, v3, t, float(scale), bool(causal), int(block_q),
+                 int(block_k))
+    out = out[:, :t]
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def reference_attention(q, k, v, causal=False, scale=None):
+    """Materialized-scores attention, the correctness oracle for the
+    kernels (and the XLA path for tiny sequence lengths)."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )

@@ -10,6 +10,7 @@ flagship for the multi-chip dryrun and the long-context benchmark.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -61,22 +62,24 @@ def transformer_lm(vocab=32000, d_model=512, n_heads=8, n_layers=4,
             from ..parallel.ring_attention import sequence_parallel_attention
 
             o = sequence_parallel_attention(q, k, v, mesh, causal=True)
-        elif mesh is None and jax.default_backend() == "tpu" and T >= 128:
+        elif mesh is None and (
+            os.environ.get("MXNET_TPU_FORCE_FLASH") == "1"
+            or (jax.default_backend() == "tpu" and T >= 128)
+        ):
             # pallas_call has no GSPMD partition rules: only take the flash
             # path when not under a sharded mesh (the sp>1 ring path above
             # composes sharding via shard_map instead)
             # Pallas flash kernel: O(T·block) memory instead of the
-            # materialized [B,H,T,T] score tensor
+            # materialized [B,H,T,T] score tensor. MXNET_TPU_FORCE_FLASH=1
+            # routes here off-TPU too (Pallas interpreter) so the wiring is
+            # testable without hardware.
             from ..ops.pallas_kernels import flash_attention
 
             o = flash_attention(q, k, v, causal=True)
         else:
-            scale = 1.0 / np.sqrt(head_dim)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(mask[None, None], s, -1e30)
-            a = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", a, v)
+            from ..ops.pallas_kernels import reference_attention
+
+            o = reference_attention(q, k, v, causal=True)
         return o.reshape(B, T, D) @ p["wo"].astype(dtype)
 
     def apply_fn(params, tokens, mesh=None):

@@ -377,7 +377,19 @@ def _pooling(attrs, ins, is_train):
     ptype = attrs.get("pool_type", "max")
     window = (1, 1) + kernel
     strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    # "full" convention (ceil output size): extend the high-side pad so
+    # reduce_window emits ceil((x+2p-k)/s)+1 windows; the avg divisor
+    # below only counts in-bounds elements so border windows stay exact.
+    hi_extra = (0,) * nd
+    if not global_pool and attrs.get("pooling_convention", "valid") == "full":
+        hi_extra = tuple(
+            max(0, (_pool_out_dim(data.shape[2 + i], kernel[i], stride[i],
+                                  pad[i], "full") - 1) * stride[i]
+                + kernel[i] - (data.shape[2 + i] + 2 * pad[i]))
+            for i in range(nd)
+        )
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(pad, hi_extra))
     # init values MUST be python scalars: a traced init keeps XLA from
     # recognizing the differentiable reduce_window_max/add patterns and
     # vjp-under-jit fails to linearize.
@@ -395,13 +407,20 @@ def _pooling(attrs, ins, is_train):
             data, zero, jax.lax.add, window, strides, padding
         )
         if ptype == "avg":
-            # divisor = clipped window area (mshadow pool divides by the
-            # valid in-bounds window size at the borders)
-            ones = jnp.ones(data.shape[2:], data.dtype)
+            # divisor = window area clipped to the PADDED extent
+            # (reference pool.h pool_sum_2d_cpu: pool_size uses
+            # hend=min(hstart+k, H+pad) before clipping to real bounds,
+            # i.e. padding counts toward the average, but the "full"
+            # convention's extra high-side extension does not)
+            cdt = data.dtype if jnp.issubdtype(data.dtype, jnp.floating) \
+                else jnp.float32
+            ones = jnp.ones(
+                tuple(data.shape[2 + i] + 2 * pad[i] for i in range(nd)), cdt)
             counts = jax.lax.reduce_window(
-                ones, zero, jax.lax.add, window[2:], strides[2:], padding[2:]
+                ones, 0.0, jax.lax.add, kernel, stride,
+                tuple((0, e) for e in hi_extra)
             )
-            out = out / counts
+            out = (out / counts).astype(data.dtype)
     else:
         raise MXNetError("Pooling: unknown pool_type %s" % ptype)
     return [out]

@@ -56,6 +56,28 @@ def _causal_block_live(qi, ki, block_q, block_k):
     )
 
 
+def _masked_scores(q, k_blk, qi, ki, *, block_q, block_k, t_real, scale,
+                   causal):
+    """The shared score/mask invariant of all three kernels:
+    s = scale·q@kᵀ on the MXU plus the (padding, causal) keep-mask for
+    this (qi, ki) block pair. Kept in ONE place so forward and backward
+    can never disagree on masking."""
+    s = jnp.float32(scale) * jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bk]
+    q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = k_pos < jnp.int32(t_real)
+    if causal:
+        mask = mask & (q_pos >= k_pos)
+    return s, mask
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_s, l_s,
                 *, block_q, block_k, t_real, scale, causal):
     qi = pl.program_id(1)
@@ -74,22 +96,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_s, l_s,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, D]
+        q = q_ref[0].astype(jnp.float32)  # [bq, D]
         k_blk = k_ref[0].astype(jnp.float32)  # [bk, D]
         v_blk = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        mask = k_pos < jnp.int32(t_real)
-        if causal:
-            mask = mask & (q_pos >= k_pos)
+        s, mask = _masked_scores(
+            q, k_blk, qi, ki, block_q=block_q, block_k=block_k,
+            t_real=t_real, scale=scale, causal=causal)
         s = jnp.where(mask, s, jnp.float32(_NEG_INF))
 
         m_prev = m_s[:, 0]
@@ -140,19 +152,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref,
         delta = d_ref[0, :, 0]
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
-        s = jnp.float32(scale) * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        mask = k_pos < jnp.int32(t_real)
-        if causal:
-            mask = mask & (q_pos >= k_pos)
+        s, mask = _masked_scores(
+            q, k_blk, qi, ki, block_q=block_q, block_k=block_k,
+            t_real=t_real, scale=scale, causal=causal)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), jnp.float32(0.0))
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -193,19 +195,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = l_ref[0, :, 0]
         delta = d_ref[0, :, 0]
-        s = jnp.float32(scale) * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        mask = k_pos < jnp.int32(t_real)
-        if causal:
-            mask = mask & (q_pos >= k_pos)
+        s, mask = _masked_scores(
+            q, k_blk, qi, ki, block_q=block_q, block_k=block_k,
+            t_real=t_real, scale=scale, causal=causal)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), jnp.float32(0.0))
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),

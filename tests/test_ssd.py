@@ -1,0 +1,100 @@
+"""SSD workload tests (parity: reference example/ssd — SURVEY.md §7
+workload 4a, the multi-output-executor north star).
+
+The full VGG16-SSD-300 symbol is checked structurally (shape inference:
+the canonical 8732 anchors). End-to-end forward/backward/update runs on a
+tiny two-scale detector so the suite stays fast on the CPU mesh.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.models import ssd
+
+
+def test_ssd300_symbol_structure():
+    net = ssd.get_symbol_train(num_classes=20)
+    args, outs, _ = net.infer_shape(data=(2, 3, 300, 300), label=(2, 8, 5))
+    by_name = dict(zip(net.list_outputs(), outs))
+    assert by_name["cls_prob_output"] == (2, 21, 8732)
+    assert by_name["loc_loss_output"] == (2, 8732 * 4)
+    assert by_name["cls_label_output"] == (2, 8732)
+    # deploy symbol decodes to [B, A, 6]
+    det = ssd.get_symbol(num_classes=20)
+    _, douts, _ = det.infer_shape(data=(1, 3, 300, 300))
+    assert douts[0] == (1, 8732, 6)
+
+
+def _tiny_detector(num_classes=3):
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), stride=(2, 2),
+                         num_filter=8, name="c1")
+    r1 = sym.Activation(c1, act_type="relu")
+    c2 = sym.Convolution(r1, kernel=(3, 3), pad=(1, 1), stride=(2, 2),
+                         num_filter=8, name="c2")
+    r2 = sym.Activation(c2, act_type="relu")
+    return data, ssd.multibox_layer(
+        [r1, r2], num_classes,
+        sizes=[(0.2, 0.3), (0.5, 0.6)],
+        ratios=[(1, 2), (1, 2, 0.5)],
+        normalization=[-1, -1])
+
+
+def test_tiny_ssd_train_step():
+    num_classes = 3
+    _, (loc_preds, cls_preds, anchors) = _tiny_detector(num_classes)
+    net = ssd.training_head(loc_preds, cls_preds, anchors, num_classes)
+
+    batch = 2
+    label = -np.ones((batch, 4, 5), np.float32)
+    label[0, 0] = [1, 0.1, 0.1, 0.5, 0.5]
+    label[0, 1] = [0, 0.6, 0.6, 0.9, 0.9]
+    label[1, 0] = [2, 0.3, 0.2, 0.8, 0.7]
+
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, 3, 16, 16))],
+             label_shapes=[("label", (batch, 4, 5))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    metric = ssd.MultiBoxMetric()
+
+    batch_data = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.RandomState(0).rand(batch, 3, 16, 16))],
+        label=[mx.nd.array(label)])
+    losses = []
+    for _ in range(8):
+        mod.forward(batch_data, is_train=True)
+        metric.reset()
+        mod.update_metric(metric, batch_data.label)
+        mod.backward()
+        mod.update()
+        names, values = metric.get()
+        assert names == ["CrossEntropy", "SmoothL1"]
+        assert np.isfinite(values[0])
+        losses.append(values[0])
+    # training must reduce the classification loss on this fixed batch
+    assert losses[-1] < losses[0]
+
+
+def test_tiny_ssd_detection_forward():
+    num_classes = 3
+    _, (loc_preds, cls_preds_flat, anchors) = _tiny_detector(num_classes)
+    cls_preds = sym.Reshape(cls_preds_flat, shape=(0, -1, num_classes + 1))
+    cls_preds = sym.transpose(cls_preds, axes=(0, 2, 1))
+    cls_prob = sym.SoftmaxActivation(cls_preds, mode="channel")
+    from mxnet_tpu.contrib import symbol as contrib_sym
+    det = contrib_sym.MultiBoxDetection(cls_prob, loc_preds, anchors,
+                                        nms_threshold=0.5)
+    exe = det.simple_bind(ctx=mx.cpu(), data=(1, 3, 16, 16))
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = np.random.RandomState(1).randn(*arr.shape) * 0.1
+    exe.arg_dict["data"][:] = np.random.RandomState(2).rand(1, 3, 16, 16)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    A = 8 * 8 * 3 + 4 * 4 * 4  # anchors of the two scales
+    assert out.shape == (1, A, 6)
+    # every row: [cls_id(-1 = suppressed), score, x1, y1, x2, y2]
+    assert ((out[..., 0] >= -1) & (out[..., 0] < num_classes)).all()
+    assert ((out[..., 1] >= 0) & (out[..., 1] <= 1)).all()

@@ -61,5 +61,14 @@ from . import test_utils
 from . import engine
 from . import parallel
 from . import contrib
+from . import executor_manager
+from . import kvstore_server
+from . import rtc
+from . import libinfo
+from . import log
 
 kv = kvstore
+
+# Parity __init__.py:37: non-worker DMLC roles get their documented no-op
+# path at import (the PS tier is subsumed by in-step XLA collectives).
+kvstore_server._init_kvstore_server_module()

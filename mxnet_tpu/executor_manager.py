@@ -1,0 +1,98 @@
+"""Executor manager: batch-slicing + multi-device executor driving.
+
+Parity: reference ``python/mxnet/executor_manager.py`` (the pre-Module
+data-parallel trainer layer used by FeedForward's
+``_train_multi_device``, model.py:132). The modern Module stack routes
+through ``module.executor_group.DataParallelExecutorGroup``; this module
+keeps the reference's standalone surface — ``_split_input_slice``,
+``_load_data``/``_load_label``, ``DataParallelExecutorManager`` — for
+scripts that drive executors directly.
+
+TPU-native: a "device slice" is a static sub-batch shape; each slice's
+executor is one compiled XLA program, and copy_params_from is a device
+put, not a cudaMemcpy.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base import MXNetError
+from .module.executor_group import (  # noqa: F401  (re-exported parity API)
+    DataParallelExecutorGroup,
+    _load_data,
+    _load_general,
+    _load_label,
+    _split_input_slice,
+)
+
+
+class DataParallelExecutorManager(object):
+    """Drive a symbol over multiple devices with sliced batches
+    (parity executor_manager.py:196 — the FeedForward-era trainer).
+
+    Internally delegates to DataParallelExecutorGroup, which compiles
+    one XLA program per device slice and shares parameters.
+    """
+
+    def __init__(self, symbol, ctx, train_data, arg_names, param_names,
+                 aux_names, work_load_list=None, logger=None,
+                 sym_gen=None):
+        if logger is None:
+            logger = logging
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = arg_names
+        self._param_names = param_names
+        self._aux_names = aux_names
+        if work_load_list is None:
+            work_load_list = [1] * len(ctx)
+        if len(work_load_list) != len(ctx):
+            raise MXNetError("Invalid settings for work load.")
+        self._work_load_list = work_load_list
+        self._data_shapes = [
+            (name, tuple(shape)) for name, shape in train_data.provide_data
+        ]
+        self._label_shapes = [
+            (name, tuple(shape)) for name, shape in train_data.provide_label
+        ]
+        self._exec_group = DataParallelExecutorGroup(
+            symbol, ctx, work_load_list, self._data_shapes,
+            self._label_shapes, param_names, for_training=True,
+            inputs_need_grad=False, shared_group=None, logger=logger,
+        )
+        self.slices = self._exec_group.slices
+
+    @property
+    def param_arrays(self):
+        return self._exec_group.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self._exec_group.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self._exec_group.aux_arrays
+
+    def install_monitor(self, monitor):
+        self._exec_group.install_monitor(monitor)
+
+    def set_params(self, arg_params, aux_params):
+        self._exec_group.set_params(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Copy current (possibly device-sharded) params into the given
+        host dicts (parity executor_manager.py:261)."""
+        self._exec_group.get_params(arg_params, aux_params)
+
+    def load_data_batch(self, data_batch):
+        self._curr_batch = data_batch
+
+    def forward(self, is_train=False):
+        self._exec_group.forward(self._curr_batch, is_train=is_train)
+
+    def backward(self):
+        self._exec_group.backward()
+
+    def update_metric(self, metric, labels):
+        self._exec_group.update_metric(metric, labels)

@@ -1,0 +1,70 @@
+"""KVStore server bootstrap — reference API parity for the PS tier.
+
+Parity: reference ``python/mxnet/kvstore_server.py`` — in the reference,
+a process whose ``DMLC_ROLE`` is ``server``/``scheduler`` blocks inside
+``import mxnet`` running a ps-lite server loop, and the rank-0 worker
+ships it a pickled Optimizer via ``SendCommandToServers(0, ...)``
+(SURVEY.md N9, §3.4).
+
+TPU-native redesign (SURVEY.md §5.8): there IS no server tier — gradient
+synchronization is an XLA all-reduce over ICI/DCN inside the compiled
+training step, and the optimizer runs (replicated or ZeRO-sharded) on
+the workers themselves. This module therefore exists to (a) give
+launcher scripts that still set ``DMLC_ROLE=server`` a well-defined,
+documented no-op path instead of a crash, and (b) keep the controller
+command protocol (command 0 = pickled optimizer) testable.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+
+
+class KVStoreServer(object):
+    """Command-loop shim for reference server processes
+    (parity kvstore_server.py:24 ``KVStoreServer``)."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handlers = {}
+        self._running = False
+
+    def _controller(self, cmd_id, cmd_body):
+        """Parity kvstore_server.py:35: command 0 installs the pickled
+        optimizer as the store's updater."""
+        if cmd_id == 0:
+            optimizer = pickle.loads(cmd_body)
+            self.kvstore.set_optimizer(optimizer)
+        else:
+            handler = self.handlers.get(cmd_id)
+            if handler is None:
+                logging.warning("server got unknown command %d", cmd_id)
+            else:
+                handler(cmd_body)
+
+    def run(self, commands=()):
+        """Process controller commands. The reference blocks forever on
+        ZMQ; with the PS tier deleted there is nothing to wait on, so
+        this drains the given commands and returns."""
+        self._running = True
+        for cmd_id, cmd_body in commands:
+            self._controller(cmd_id, cmd_body)
+        self._running = False
+
+
+def _init_kvstore_server_module():
+    """Parity kvstore_server.py:58 / __init__.py:37: called at import.
+
+    In the reference this never returns for server/scheduler roles. Here
+    non-worker roles log that the PS tier is subsumed by in-step XLA
+    collectives and return immediately, so a reference launcher that
+    still spawns servers degrades to harmless processes.
+    """
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        logging.info(
+            "DMLC_ROLE=%s: no parameter-server tier in the TPU-native "
+            "build (gradient sync is an XLA collective inside the "
+            "compiled step); role is a no-op.", role)
+    return role

@@ -1,0 +1,26 @@
+"""Library information (parity: reference ``python/mxnet/libinfo.py``).
+
+The reference locates ``libmxnet.so`` and pins ``__version__``; here the
+"library" is the in-tree native runtime (``mxnet_tpu/src``) plus the JAX
+backend, so find_lib_path points at the built native artifacts when they
+exist.
+"""
+from __future__ import annotations
+
+import os
+
+# Capability-parity version: tracks the reference release whose surface
+# this framework reproduces (include/mxnet/base.h:86-92).
+__version__ = "0.9.5"
+
+
+def find_lib_path():
+    """Paths of the native runtime artifacts, if built (the analog of
+    the reference's libmxnet.so search, libinfo.py:12-44)."""
+    src_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+    libs = []
+    if os.path.isdir(src_dir):
+        for fname in sorted(os.listdir(src_dir)):
+            if fname.endswith((".so", ".dylib", ".dll")):
+                libs.append(os.path.join(src_dir, fname))
+    return libs

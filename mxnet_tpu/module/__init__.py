@@ -9,4 +9,5 @@ from .module import Module
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule, PythonLossModule
+from .mutable_module import MutableModule
 from .executor_group import DataParallelExecutorGroup

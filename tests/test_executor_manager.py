@@ -1,0 +1,105 @@
+"""executor_manager + kvstore_server parity tests (reference
+python/mxnet/executor_manager.py, kvstore_server.py)."""
+import pickle
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.executor_manager import (
+    DataParallelExecutorManager,
+    _split_input_slice,
+)
+from mxnet_tpu.kvstore_server import KVStoreServer
+
+
+def test_split_input_slice():
+    slices = _split_input_slice(10, [1, 1])
+    assert [(s.start, s.stop) for s in slices] == [(0, 5), (5, 10)]
+    slices = _split_input_slice(9, [2, 1])
+    assert [(s.start, s.stop) for s in slices] == [(0, 6), (6, 9)]
+
+
+def _blobs(n=120, d=8, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 4
+    X = np.concatenate([c + rng.randn(n // k, d) * 0.3 for c in centers])
+    y = np.repeat(np.arange(k), n // k).astype(np.float32)
+    p = rng.permutation(n)
+    return X[p].astype(np.float32), y[p]
+
+
+def test_executor_manager_train_loop():
+    X, y = _blobs()
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    arg_names = net.list_arguments()
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    mgr = DataParallelExecutorManager(
+        net, [mx.cpu(0), mx.cpu(1)], it, arg_names, param_names,
+        net.list_auxiliary_states())
+
+    arg_shapes, _, _ = net.infer_shape(data=(20, 8))
+    init = mx.init.Xavier()
+    arg_params = {}
+    for name, shape in zip(arg_names, arg_shapes):
+        if name in param_names:
+            arr = mx.nd.zeros(shape)
+            init(mx.init.InitDesc(name), arr)
+            arg_params[name] = arr
+    mgr.set_params(arg_params, {})
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0 / 20)
+    updater = mx.optimizer.get_updater(opt)
+    metric = mx.metric.Accuracy()
+    for epoch in range(8):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mgr.load_data_batch(batch)
+            mgr.forward(is_train=True)
+            mgr.backward()
+            for idx, (p_list, g_list) in enumerate(
+                    zip(mgr.param_arrays, mgr.grad_arrays)):
+                # sum device-sliced grads, update once, broadcast (the
+                # reference's _update_params no-kvstore path)
+                gsum = sum(g.asnumpy() for g in g_list)
+                w = p_list[0].asnumpy()
+                warr = mx.nd.array(w)
+                updater(idx, mx.nd.array(gsum), warr)
+                for p in p_list:
+                    p[:] = warr.asnumpy()
+            mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9
+
+    # copy_to round-trips the trained params
+    out = {n: mx.nd.zeros(a.shape) for n, a in arg_params.items()}
+    mgr.copy_to(out, {})
+    assert any(
+        not np.allclose(out[n].asnumpy(), arg_params[n].asnumpy())
+        for n in out
+    )
+
+
+def test_kvstore_server_command_protocol():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 2)))
+    server = KVStoreServer(kv)
+    opt = mx.optimizer.SGD(learning_rate=0.5)
+    server.run([(0, pickle.dumps(opt))])
+    # updater installed: push applies -0.5 * grad
+    kv.push(3, mx.nd.ones((2, 2)))
+    out = mx.nd.zeros((2, 2))
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 0.5), rtol=1e-5)
+
+
+def test_server_role_import_is_noop(monkeypatch):
+    from mxnet_tpu.kvstore_server import _init_kvstore_server_module
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    assert _init_kvstore_server_module() == "server"

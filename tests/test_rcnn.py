@@ -1,0 +1,123 @@
+"""Faster R-CNN workload tests (parity: reference example/rcnn —
+SURVEY.md §7 workload 4b). Exercises the full chain the reference's
+MutableModule training runs: RPN losses, native Proposal, the
+proposal_target python CustomOp, ROIPooling, two-head Fast R-CNN top —
+end to end through MutableModule, including a variable-size rebind.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import rcnn
+
+FS = 4                 # tiny backbone stride
+SCALES = (2, 4)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+
+
+def _make_symbol():
+    return rcnn.get_symbol_train(
+        num_classes=3, backbone="tiny", feature_stride=FS,
+        scales=SCALES, ratios=RATIOS, rpn_batch_size=16, batch_rois=8,
+        rpn_pre_nms_top_n=32, rpn_post_nms_top_n=16, rpn_min_size=2,
+        pooled_size=(3, 3), hidden=32)
+
+
+def _make_batch(im_hw, seed=0):
+    H, W = im_hw
+    h, w = H // FS, W // FS
+    rng = np.random.RandomState(seed)
+    data = rng.rand(1, 3, H, W).astype(np.float32)
+    im_info = np.array([[H, W, 1.0]], np.float32)
+    # classes are 0-based foreground ids (label = cls+1, 0 = background)
+    gt = np.array([[2.0, 2.0, H * 0.6, W * 0.6, 0.0],
+                   [H * 0.3, W * 0.3, H - 3.0, W - 3.0, 1.0]], np.float32)
+    lab, tgt, wgt = rcnn.assign_anchors(
+        gt, (h, w), (H, W), feature_stride=FS, scales=SCALES,
+        ratios=RATIOS, batch_size=16, fg_overlap=0.5, bg_overlap=0.3)
+    return mx.io.DataBatch(
+        data=[mx.nd.array(data), mx.nd.array(im_info),
+              mx.nd.array(gt[None])],
+        label=[mx.nd.array(lab), mx.nd.array(tgt), mx.nd.array(wgt)],
+        provide_data=[("data", data.shape), ("im_info", (1, 3)),
+                      ("gt_boxes", (1,) + gt.shape)],
+        provide_label=[("rpn_label", lab.shape),
+                       ("rpn_bbox_target", tgt.shape),
+                       ("rpn_bbox_weight", wgt.shape)])
+
+
+def test_proposal_target_custom_op():
+    rois = np.array([[0, 0, 0, 10, 10],
+                     [0, 1, 1, 12, 12],
+                     [0, 20, 20, 30, 30]], np.float32)
+    gt = np.array([[[0, 0, 11, 11, 1.0]]], np.float32)
+    out = mx.sym.Custom(mx.sym.Variable("rois"), mx.sym.Variable("gt"),
+                        op_type="proposal_target", num_classes=3,
+                        batch_rois=4, fg_fraction=0.5)
+    exe = out.simple_bind(mx.cpu(), rois=(3, 5), gt=(1, 1, 5))
+    exe.arg_dict["rois"][:] = rois
+    exe.arg_dict["gt"][:] = gt
+    sampled, label, bt, bw = [o.asnumpy() for o in exe.forward()]
+    assert sampled.shape == (4, 5) and label.shape == (4,)
+    assert bt.shape == (4, 12) and bw.shape == (4, 12)
+    # the overlapping rois (and the injected gt box) are foreground cls 2
+    assert (label == 2).sum() >= 2
+    # weights are only set on the fg rows, in the class-2 slot
+    fg = label == 2
+    assert bw[fg][:, 8:12].all() and not bw[fg][:, :8].any()
+    assert not bw[~fg].any()
+
+
+def test_rcnn_end2end_mutable_module():
+    net = _make_symbol()
+    batch32 = _make_batch((32, 32), seed=0)
+    batch16 = _make_batch((16, 32), seed=1)  # different H → rebind path
+
+    mod = mx.mod.MutableModule(
+        net, data_names=("data", "im_info", "gt_boxes"),
+        label_names=("rpn_label", "rpn_bbox_target", "rpn_bbox_weight"),
+        context=mx.cpu(),
+        max_data_shapes=[("data", (1, 3, 32, 32))])
+    mod.bind(data_shapes=batch32.provide_data,
+             label_shapes=batch32.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+
+    assert mod._curr_module is mod._base_module
+    for step, batch in enumerate([batch32, batch32, batch16, batch32]):
+        mod.forward(batch, is_train=True)
+        if step == 2:
+            # variable-size image triggered a shared-param rebind
+            assert mod._curr_module is not mod._base_module
+        outs = [o.asnumpy() for o in mod.get_outputs()]
+        # [rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss, label]
+        assert all(np.isfinite(o).all() for o in outs), step
+        mod.backward()
+        mod.update()
+    # cls_prob rows are distributions over the 3 classes
+    cls_prob = outs[2]
+    np.testing.assert_allclose(cls_prob.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_mutable_module_force_rebind_keeps_params():
+    net = _make_symbol()
+    batch32 = _make_batch((32, 32), seed=0)
+    mod = mx.mod.MutableModule(
+        net, data_names=("data", "im_info", "gt_boxes"),
+        label_names=("rpn_label", "rpn_bbox_target", "rpn_bbox_weight"),
+        context=mx.cpu())
+    mod.bind(data_shapes=batch32.provide_data,
+             label_shapes=batch32.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    before, _ = mod.get_params()
+    mod.bind(data_shapes=batch32.provide_data,
+             label_shapes=batch32.provide_label, force_rebind=True)
+    assert mod.params_initialized
+    after, _ = mod.get_params()
+    for name in before:
+        np.testing.assert_allclose(
+            after[name].asnumpy(), before[name].asnumpy(), rtol=1e-6)
+    # and the rebound module still runs
+    mod.forward(batch32, is_train=False)
+    assert np.isfinite(mod.get_outputs()[0].asnumpy()).all()

@@ -66,6 +66,9 @@ from . import kvstore_server
 from . import rtc
 from . import libinfo
 from . import log
+from . import predict
+from . import torch
+from . import torch as th
 
 kv = kvstore
 

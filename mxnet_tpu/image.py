@@ -375,6 +375,12 @@ class ImageIter(DataIter):
         )
         if scale != 1.0:
             aug.append(lambda src: [src * scale])
+        if path_imgidx is None and path_imgrec.endswith(".rec"):
+            # im2rec always writes the sibling .idx; pick it up so
+            # shuffle/partition work without the extra param
+            candidate = path_imgrec[:-4] + ".idx"
+            if os.path.exists(candidate):
+                path_imgidx = candidate
         return cls(
             batch_size, tuple(data_shape), label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,

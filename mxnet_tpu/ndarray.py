@@ -478,6 +478,20 @@ _MAGIC = b"MXTPU001"
 
 
 def save(fname, data):
+    with open(fname, "wb") as f:
+        _save_fileobj(f, data)
+
+
+def save_buffer(data):
+    """Serialize NDArrays to bytes (the c_predict param-bytes format)."""
+    import io
+
+    f = io.BytesIO()
+    _save_fileobj(f, data)
+    return f.getvalue()
+
+
+def _save_fileobj(f, data):
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -486,42 +500,53 @@ def save(fname, data):
     else:
         names = []
         arrays = list(data)
-    with open(fname, "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<qq", len(arrays), len(names)))
-        for n in names:
-            b = n.encode()
-            f.write(struct.pack("<q", len(b)))
-            f.write(b)
-        for a in arrays:
-            arr = a.asnumpy()
-            f.write(struct.pack("<q", mx_dtype_code(arr.dtype)))
-            f.write(struct.pack("<q", arr.ndim))
-            f.write(struct.pack("<%dq" % arr.ndim, *arr.shape))
-            f.write(np.ascontiguousarray(arr).tobytes())
+    f.write(_MAGIC)
+    f.write(struct.pack("<qq", len(arrays), len(names)))
+    for n in names:
+        b = n.encode()
+        f.write(struct.pack("<q", len(b)))
+        f.write(b)
+    for a in arrays:
+        arr = a.asnumpy()
+        f.write(struct.pack("<q", mx_dtype_code(arr.dtype)))
+        f.write(struct.pack("<q", arr.ndim))
+        f.write(struct.pack("<%dq" % arr.ndim, *arr.shape))
+        f.write(np.ascontiguousarray(arr).tobytes())
 
 
 def load(fname):
+    with open(fname, "rb") as f:
+        return _load_fileobj(f, fname)
+
+
+def load_buffer(buf):
+    """Deserialize NDArrays from an in-memory bytes buffer (parity: the
+    c_predict_api path, MXNDListCreate over param bytes)."""
+    import io
+
+    return _load_fileobj(io.BytesIO(buf), "<buffer>")
+
+
+def _load_fileobj(f, fname):
     from .base import _DTYPE_MX_TO_NP
 
-    with open(fname, "rb") as f:
-        magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
-            raise MXNetError("invalid NDArray file %s" % fname)
-        n_arr, n_names = struct.unpack("<qq", f.read(16))
-        names = []
-        for _ in range(n_names):
-            (ln,) = struct.unpack("<q", f.read(8))
-            names.append(f.read(ln).decode())
-        arrays = []
-        for _ in range(n_arr):
-            (code,) = struct.unpack("<q", f.read(8))
-            (ndim,) = struct.unpack("<q", f.read(8))
-            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
-            dt = np.dtype(_DTYPE_MX_TO_NP[code])
-            count = int(np.prod(shape)) if shape else 1
-            arr = np.frombuffer(f.read(count * dt.itemsize), dtype=dt).reshape(shape)
-            arrays.append(array(arr, dtype=dt))
+    magic = f.read(len(_MAGIC))
+    if magic != _MAGIC:
+        raise MXNetError("invalid NDArray file %s" % fname)
+    n_arr, n_names = struct.unpack("<qq", f.read(16))
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack("<q", f.read(8))
+        names.append(f.read(ln).decode())
+    arrays = []
+    for _ in range(n_arr):
+        (code,) = struct.unpack("<q", f.read(8))
+        (ndim,) = struct.unpack("<q", f.read(8))
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+        dt = np.dtype(_DTYPE_MX_TO_NP[code])
+        count = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(f.read(count * dt.itemsize), dtype=dt).reshape(shape)
+        arrays.append(array(arr, dtype=dt))
     if names:
         return dict(zip(names, arrays))
     return arrays

@@ -11,6 +11,7 @@ PlaceDevice pass) becomes PartitionSpec annotations.
 """
 from .mesh import (
     make_mesh, barrier, dp_sharding, replicated_sharding, device_count,
+    init_distributed,
 )
 from .train_step import ShardedTrainStep
 from .ring_attention import ring_attention

@@ -65,3 +65,39 @@ def barrier(mesh=None):
 
     x = jnp.ones(())
     jax.block_until_ready(x + 0)
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Join the multi-host JAX runtime (the worker-side counterpart of
+    tools/launch.py — the TPU replacement for the reference's
+    DMLC_PS_ROOT_URI bootstrap, kvstore.h InitPSEnv).
+
+    Reads JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID
+    (as set by tools/launch.py) when args are omitted; a single-process
+    job is a no-op. Safe to call twice.
+    """
+    import os
+
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    num_processes = int(num_processes or os.environ.get(
+        "JAX_NUM_PROCESSES", 1))
+    process_id = int(process_id if process_id is not None
+                     else os.environ.get("JAX_PROCESS_ID", 0))
+    if num_processes <= 1 or coordinator_address is None:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except RuntimeError as e:
+        # jax 0.9 raises "distributed.initialize should only be called
+        # once."; older versions say "already initialized"
+        msg = str(e).lower()
+        if "already" in msg or "once" in msg:
+            return True
+        raise
+    return True

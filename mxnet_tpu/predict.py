@@ -1,0 +1,147 @@
+"""Standalone inference — ``c_predict_api`` parity.
+
+Parity: reference ``src/c_api/c_predict_api.cc`` /
+``include/mxnet/c_predict_api.h:59-140`` (SURVEY.md §3.6): a
+self-contained predictor ABI — ``MXPredCreate(symbol_json, param_bytes,
+dev, input_shapes)`` → ``MXPredSetInput`` → ``MXPredForward`` →
+``MXPredGetOutput`` — that the amalgamation ships to mobile/JS.
+
+TPU-native: ``Predictor`` AOT-compiles the whole inference graph to one
+XLA executable at construction (the reference builds a pruned
+MXNET_PREDICT_ONLY executor); ``forward`` is a single device call. The
+reference's partial-shape re-create (``MXPredReshape``) maps to
+``reshape()`` which compiles one more program and keeps the weights.
+
+The amalgamation analog is ``export_bundle``/``load_bundle``: one file
+that contains symbol JSON + params, loadable with zero framework state.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+from .context import Context, cpu
+
+
+class Predictor(object):
+    """``MXPredCreate`` equivalent.
+
+    Parameters
+    ----------
+    symbol_json : str — symbol graph JSON (``Symbol.tojson()``)
+    param_raw : bytes | dict — serialized params (``nd.save`` format with
+        ``arg:``/``aux:`` prefixed names, as ``save_checkpoint`` writes)
+        or an already-loaded {name: NDArray} dict
+    input_shapes : dict of name → shape
+    ctx : Context (default cpu())
+    """
+
+    def __init__(self, symbol_json, param_raw, input_shapes, ctx=None):
+        self.symbol = sym_mod.load_json(symbol_json)
+        ctx = ctx if ctx is not None else cpu()
+        if isinstance(param_raw, (bytes, bytearray)):
+            loaded = nd.load_buffer(bytes(param_raw))
+        else:
+            loaded = param_raw
+        if not isinstance(loaded, dict):
+            raise MXNetError(
+                "Predictor needs NAMED params (a dict serialized by "
+                "nd.save / save_checkpoint); got an unnamed list")
+        arg_params, aux_params = {}, {}
+        for k, v in loaded.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        self._ctx = ctx
+        self._input_shapes = dict(input_shapes)
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self._bind()
+
+    def _bind(self):
+        self._exec = self.symbol.simple_bind(
+            ctx=self._ctx, grad_req="null", **self._input_shapes)
+        for name, arr in self._arg_params.items():
+            if name in self._exec.arg_dict:
+                if tuple(self._exec.arg_dict[name].shape) != tuple(arr.shape):
+                    raise MXNetError(
+                        "param %s shape mismatch %s vs %s"
+                        % (name, arr.shape, self._exec.arg_dict[name].shape))
+                self._exec.arg_dict[name][:] = arr.asnumpy()
+        for name, arr in self._aux_params.items():
+            if name in self._exec.aux_dict:
+                self._exec.aux_dict[name][:] = arr.asnumpy()
+
+    # -- c_predict_api surface ----------------------------------------
+    def set_input(self, name, data):
+        """``MXPredSetInput``."""
+        if name not in self._input_shapes:
+            raise MXNetError("unknown input %s" % name)
+        data = np.asarray(data)
+        want = tuple(self._exec.arg_dict[name].shape)
+        if tuple(data.shape) != want:
+            raise MXNetError(
+                "input %s shape %s does not match bound shape %s"
+                % (name, tuple(data.shape), want))
+        self._exec.arg_dict[name][:] = data
+
+    def forward(self):
+        """``MXPredForward``."""
+        self._exec.forward(is_train=False)
+
+    def get_output(self, index=0):
+        """``MXPredGetOutput`` → numpy."""
+        return self._exec.outputs[index].asnumpy()
+
+    def reshape(self, new_input_shapes):
+        """``MXPredReshape``: rebind with new shapes, keep weights."""
+        self._input_shapes.update(new_input_shapes)
+        self._bind()
+
+    def predict(self, **inputs):
+        """Convenience: set all inputs, forward, return all outputs."""
+        for name, data in inputs.items():
+            self.set_input(name, data)
+        self.forward()
+        return [o.asnumpy() for o in self._exec.outputs]
+
+
+# --------------------------------------------------------------------------
+# amalgamation analog: single-file inference bundle
+# --------------------------------------------------------------------------
+
+_BUNDLE_MAGIC = b"MXTPUPRED1"
+
+
+def export_bundle(fname, symbol, arg_params, aux_params=None):
+    """Write symbol JSON + params as ONE file (the role the reference's
+    amalgamation plays: a self-contained deployable predict artifact)."""
+    js = symbol.tojson().encode()
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    if aux_params:
+        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_bytes = nd.save_buffer(save_dict)
+    with open(fname, "wb") as f:
+        f.write(_BUNDLE_MAGIC)
+        f.write(struct.pack("<qq", len(js), len(param_bytes)))
+        f.write(js)
+        f.write(param_bytes)
+
+
+def load_bundle(fname, input_shapes, ctx=None):
+    """Load an ``export_bundle`` file into a ready Predictor."""
+    with open(fname, "rb") as f:
+        magic = f.read(len(_BUNDLE_MAGIC))
+        if magic != _BUNDLE_MAGIC:
+            raise MXNetError("%s is not a predictor bundle" % fname)
+        js_len, p_len = struct.unpack("<qq", f.read(16))
+        js = f.read(js_len).decode()
+        param_bytes = f.read(p_len)
+    return Predictor(js, param_bytes, input_shapes, ctx=ctx)

@@ -1,0 +1,173 @@
+"""Torch interoperability — the torch plugin, reimplemented for TPU.
+
+Parity: reference ``plugin/torch`` + ``python/mxnet/torch.py`` (N23):
+run (Lua-)Torch tensor functions and nn modules as MXNet operators. Here
+the host framework is PyTorch (CPU build baked into the image) and the
+bridge is the CustomOp host: torch computations execute as host
+callbacks (``jax.pure_callback`` under the hood), with gradients
+threaded through ``torch.autograd`` — so a torch ``nn.Module`` can sit
+in the middle of an otherwise XLA-compiled graph.
+
+Two surfaces:
+
+- function namespace: ``mx.th.exp(x)``, ``mx.th.mm(a, b)`` ... — any
+  ``torch.*`` function applied to NDArrays (reference torch.py generated
+  wrappers).
+- ``wrap_module(nn_module)`` → a symbol factory: embeds the module as a
+  trainable-free graph op with exact torch forward/backward (reference
+  TorchModule op, ``plugin/torch/torch_module-inl.h``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import operator
+from . import symbol as sym_mod
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+
+def _torch():
+    try:
+        import torch as _t
+        return _t
+    except ImportError:
+        raise MXNetError(
+            "torch interop requires pytorch (baked into this image)")
+
+
+def _to_torch(x):
+    t = _torch()
+    if isinstance(x, NDArray):
+        # copy: jax buffers are read-only and torch requires writable
+        return t.from_numpy(np.array(x.asnumpy()))
+    if isinstance(x, np.ndarray):
+        return t.from_numpy(np.array(x))
+    return x
+
+
+def _from_torch(v):
+    t = _torch()
+    if isinstance(v, t.Tensor):
+        return array(v.detach().cpu().numpy())
+    return v
+
+
+def __getattr__(name):
+    """mx.th.<fn>: call torch.<fn> on NDArrays (PEP 562 module attr)."""
+    try:
+        t = _torch()
+    except MXNetError as e:
+        # PEP 562 contract: missing attributes must raise AttributeError
+        # so hasattr()/getattr(default) degrade instead of crashing
+        raise AttributeError(str(e))
+    fn = getattr(t, name, None)
+    if fn is None or not callable(fn):
+        raise AttributeError("torch has no function %r" % name)
+
+    def wrapper(*args, **kwargs):
+        targs = [_to_torch(a) for a in args]
+        tkwargs = {k: _to_torch(v) for k, v in kwargs.items()}
+        out = fn(*targs, **tkwargs)
+        if isinstance(out, (list, tuple)):
+            return type(out)(_from_torch(v) for v in out)
+        return _from_torch(out)
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+# --------------------------------------------------------------------------
+# nn.Module as a graph op
+# --------------------------------------------------------------------------
+
+_WRAPPED = {}
+
+
+def wrap_module(nn_module, name=None):
+    """Register a torch ``nn.Module`` as a CustomOp and return a symbol
+    factory ``f(data_sym, name=...) -> Symbol``.
+
+    The module runs on the host in float32; forward saves the graph and
+    backward calls ``torch.autograd.grad`` w.r.t. the op input AND the
+    module's own parameters, applying parameter gradients directly to
+    the torch module (torch params are NOT visible to the MXNet
+    optimizer — matching the reference TorchModule's self-owned weights
+    updated by its own updateParameters).
+    """
+    t = _torch()
+    op_name = name or ("torch_%s_%d" % (
+        type(nn_module).__name__.lower(), len(_WRAPPED)))
+    if op_name in _WRAPPED:
+        raise MXNetError("torch module op %r already registered" % op_name)
+    _WRAPPED[op_name] = nn_module
+
+    @operator.register(op_name)
+    class _TorchModuleProp(operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            was_training = nn_module.training
+            nn_module.eval()  # the zero-probe must not touch BN stats
+            try:
+                with t.no_grad():
+                    probe = t.zeros(*[int(d) for d in in_shape[0]])
+                    out = nn_module(probe)
+            finally:
+                nn_module.train(was_training)
+            return [in_shape[0]], [tuple(out.shape)], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class _TorchModuleOp(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = _to_torch(in_data[0]).float()
+                    # keep torch's train/eval semantics (Dropout,
+                    # BatchNorm running stats) in sync with mx is_train
+                    nn_module.train(bool(is_train))
+                    if is_train:
+                        x.requires_grad_(True)
+                        y = nn_module(x)
+                        self._saved = (x, y)
+                    else:
+                        with t.no_grad():
+                            y = nn_module(x)
+                    self.assign(out_data[0], req[0],
+                                y.detach().cpu().numpy())
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    x, y = self._saved
+                    gy = _to_torch(out_grad[0]).float()
+                    params = [p for p in nn_module.parameters()
+                              if p.requires_grad]
+                    grads = t.autograd.grad(
+                        y, [x] + params, grad_outputs=gy,
+                        allow_unused=True, retain_graph=False)
+                    gx = grads[0]
+                    self.assign(
+                        in_grad[0], req[0],
+                        np.zeros(x.shape, np.float32) if gx is None
+                        else gx.cpu().numpy())
+                    with t.no_grad():
+                        for p, g in zip(params, grads[1:]):
+                            if g is not None:
+                                if p.grad is None:
+                                    p.grad = g.clone()
+                                else:
+                                    p.grad += g
+
+            return _TorchModuleOp()
+
+    def build(data_sym, name=None, **kwargs):
+        return sym_mod.Custom(data_sym, op_type=op_name,
+                              name=name or op_name, **kwargs)
+
+    build.op_name = op_name
+    return build

@@ -1,0 +1,85 @@
+"""Tools parity tests: im2rec packer (reference tools/im2rec.*),
+launch.py env contract (tools/launch.py + dmlc tracker), and the
+allreduce bandwidth measure (tools/bandwidth/measure.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+sys.path.insert(0, TOOLS)
+
+
+def _write_images(root, n_per_class=3, classes=("cat", "dog")):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for cls in classes:
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_per_class):
+            arr = (rng.rand(24, 32, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, "img%d.jpg" % i))
+
+
+def test_im2rec_list_pack_and_iterate(tmp_path):
+    import im2rec
+
+    root = str(tmp_path / "imgs")
+    _write_images(root)
+    prefix = str(tmp_path / "data")
+    out, classes = im2rec.make_list(prefix, root)
+    assert len(classes) == 2
+    lines = open(out).read().strip().splitlines()
+    assert len(lines) == 6
+
+    n = im2rec.pack(prefix, root, num_workers=1, resize=0)
+    assert n == 6
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    # records round-trip through the recordio reader
+    reader = mx.recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                           "r")
+    assert len(reader.keys) == 6
+    header, img = mx.recordio.unpack_img(reader.read_idx(reader.keys[0]))
+    assert img.shape == (24, 32, 3)
+    reader.close()
+
+    # and feed training through the ImageRecordIter surface
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 24, 24), batch_size=2,
+                               rand_crop=True, shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 24, 24)
+    assert batch.label[0].shape == (2,)
+
+
+def test_launch_local_env_contract(tmp_path):
+    import launch
+
+    env = launch.worker_env(2, 4, "127.0.0.1:29500")
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["DMLC_RANK"] == "2"
+    assert env["DMLC_NUM_WORKER"] == "4"
+    assert env["DMLC_ROLE"] == "worker"
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.exit(0 if os.environ['DMLC_RANK'] in '0123' and "
+        "os.environ['DMLC_NUM_WORKER'] == '2' else 1)\n")
+    rc = launch.launch_local(2, [sys.executable, str(script)])
+    assert rc == 0
+
+
+def test_bandwidth_measure_runs():
+    sys.path.insert(0, os.path.join(TOOLS, "bandwidth"))
+    import measure
+
+    results = measure.measure(sizes_mb=(0.25,), iters=2)
+    assert results[0]["devices"] >= 1
+    assert results[0]["busbw_GBps"] >= 0.0

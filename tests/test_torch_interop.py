@@ -1,0 +1,71 @@
+"""Torch plugin parity tests (reference plugin/torch +
+python/mxnet/torch.py): torch functions on NDArrays and a torch
+nn.Module embedded mid-graph with gradients through torch.autograd."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+
+
+def test_th_function_namespace():
+    x = mx.nd.array(np.array([[0.0, 1.0], [2.0, 3.0]], np.float32))
+    out = mx.th.exp(x)
+    np.testing.assert_allclose(out.asnumpy(), np.exp(x.asnumpy()),
+                               rtol=1e-6)
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((3, 4))
+    mm = mx.th.mm(a, b)
+    np.testing.assert_allclose(mm.asnumpy(), np.full((2, 4), 3.0))
+
+
+def test_torch_module_mid_graph_training():
+    torch.manual_seed(0)
+    tmod = torch.nn.Sequential(
+        torch.nn.Linear(8, 8), torch.nn.Tanh())
+    build = mx.torch.wrap_module(tmod, name="torch_tanh_block")
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = build(h)
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(40, 4).astype(np.float32)
+    y = (X.sum(axis=1) > 2).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    fc1_before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    torch_w_before = [p.detach().clone() for p in tmod.parameters()]
+    metric = mx.metric.Accuracy()
+    for _ in range(15):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+            # torch params keep their own grads (reference TorchModule
+            # owns its weights); step them with plain SGD here
+            with torch.no_grad():
+                for p in tmod.parameters():
+                    if p.grad is not None:
+                        p -= 0.05 * p.grad
+                        p.grad = None
+    # gradients flowed BOTH into mx params upstream of the torch block
+    # and into the torch module's own weights
+    fc1_after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert not np.allclose(fc1_after, fc1_before)
+    assert any(
+        not torch.allclose(p.detach(), w0)
+        for p, w0 in zip(tmod.parameters(), torch_w_before)
+    )
+    assert metric.get()[1] > 0.8

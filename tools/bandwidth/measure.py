@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Gradient-synchronization bandwidth benchmark.
+
+Parity: reference ``tools/bandwidth/measure.py`` — measures the KVStore
+push+pull bandwidth that bounds data-parallel scaling (SURVEY.md §6,
+"allreduce bandwidth").
+
+TPU-native: the synchronization primitive is an XLA all-reduce (psum)
+over the device mesh, so this measures jitted psum throughput across
+message sizes and reports the standard algorithmic-bandwidth figure
+busbw = 2·(n-1)/n · bytes / time per device.
+
+Run (virtual 8-device mesh off-TPU):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python tools/bandwidth/measure.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def measure(sizes_mb=(1, 4, 16, 64), iters=10, dtype="float32"):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+
+    results = []
+    for mb in sizes_mb:
+        elems = int(mb * 2 ** 20 / np.dtype(dtype).itemsize)
+        # per-device shard; allreduce payload = full array
+        x = jnp.ones((n, elems), dtype)
+
+        @jax.jit
+        def allreduce(v):
+            return shard_map(
+                lambda s: jax.lax.psum(s, "dp"),
+                mesh=mesh, in_specs=P("dp", None), out_specs=P("dp", None),
+            )(v)
+
+        allreduce(x).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = allreduce(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        bytes_ = elems * np.dtype(dtype).itemsize
+        busbw = 2.0 * (n - 1) / n * bytes_ / dt / 1e9
+        results.append({"size_mb": mb, "time_ms": dt * 1e3,
+                        "busbw_GBps": busbw, "devices": n})
+        print("size %6.1f MB  time %8.3f ms  busbw %7.2f GB/s (n=%d)"
+              % (mb, dt * 1e3, busbw, n))
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes-mb", type=float, nargs="+",
+                   default=[1, 4, 16, 64])
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--dtype", default="float32")
+    args = p.parse_args(argv)
+    measure(tuple(args.sizes_mb), args.iters, args.dtype)
+
+
+if __name__ == "__main__":
+    main()

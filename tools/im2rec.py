@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""im2rec: pack an image dataset into RecordIO shards.
+
+Parity: reference ``tools/im2rec.py`` / ``tools/im2rec.cc`` (N26) — the
+dataset packer that turns an image directory (or a prepared ``.lst``
+file of ``index\\tlabel\\tpath`` lines) into ``.rec`` (+``.idx``) files
+that ``ImageRecordIter`` streams at training time.
+
+TPU-relevant design: packing parallelism uses a process pool (the
+reference uses an OpenMP decode team); records are written by a single
+writer thread in index order so shards are deterministic.
+
+Usage:
+  python tools/im2rec.py --list prefix image_root   # make prefix.lst
+  python tools/im2rec.py prefix image_root          # pack prefix.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from mxnet_tpu import recordio
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True,
+              seed=0):
+    """Walk ``root`` and write ``prefix.lst`` (label = folder index,
+    parity im2rec.py list mode)."""
+    entries = []
+    classes = {}
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        if not recursive and dirpath != root:
+            continue
+        for fname in sorted(filenames):
+            if fname.lower().endswith(_EXTS):
+                rel = os.path.relpath(os.path.join(dirpath, fname), root)
+                cls = os.path.dirname(rel) or "."
+                label = classes.setdefault(cls, len(classes))
+                entries.append((label, rel))
+    if shuffle:
+        rng = np.random.RandomState(seed)
+        rng.shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    out = "%s.lst" % prefix
+    with open(out, "w") as f:
+        for i, (label, rel) in enumerate(entries[:n_train]):
+            f.write("%d\t%f\t%s\n" % (i, float(label), rel))
+    if train_ratio < 1.0:
+        with open("%s_val.lst" % prefix, "w") as f:
+            for i, (label, rel) in enumerate(entries[n_train:]):
+                f.write("%d\t%f\t%s\n" % (i, float(label), rel))
+    return out, classes
+
+
+def read_list(lst_path):
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            path = parts[-1]
+            yield idx, labels, path
+
+
+def _process_image(args):
+    """Worker: load → optional resize → re-encode JPEG → packed record."""
+    idx, labels, path, root, resize, quality, color = args
+    from PIL import Image
+
+    full = os.path.join(root, path)
+    try:
+        img = Image.open(full)
+        img = img.convert("L" if color == 0 else "RGB")
+        if resize:
+            w, h = img.size
+            short = min(w, h)
+            scale = resize / float(short)
+            img = img.resize((max(1, int(w * scale)),
+                              max(1, int(h * scale))))
+        arr = np.asarray(img)
+        label = labels[0] if len(labels) == 1 else np.asarray(
+            labels, np.float32)
+        header = (0, label, idx, 0)  # IRHeader (flag, label, id, id2)
+        return idx, recordio.pack_img(header, arr, quality=quality)
+    except Exception as e:  # noqa: BLE001 — skip unreadable images like the reference
+        print("im2rec: skipping %s (%s)" % (path, e), file=sys.stderr)
+        return idx, None
+
+
+def pack(prefix, root, num_workers=4, resize=0, quality=95, color=1):
+    """Pack ``prefix.lst`` into ``prefix.rec`` + ``prefix.idx``."""
+    import multiprocessing as mp
+
+    lst = "%s.lst" % prefix
+    items = [(idx, labels, path, root, resize, quality, color)
+             for idx, labels, path in read_list(lst)]
+    writer = recordio.MXIndexedRecordIO("%s.idx" % prefix,
+                                        "%s.rec" % prefix, "w")
+    n = 0
+    if num_workers > 1:
+        with mp.Pool(num_workers) as pool:
+            for idx, payload in pool.imap(_process_image, items,
+                                          chunksize=16):
+                if payload is not None:
+                    writer.write_idx(idx, payload)
+                    n += 1
+    else:
+        for item in items:
+            idx, payload = _process_image(item)
+            if payload is not None:
+                writer.write_idx(idx, payload)
+                n += 1
+    writer.close()
+    print("im2rec: packed %d records into %s.rec" % (n, prefix))
+    return n
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="prefix of .lst/.rec/.idx files")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="make the .lst file instead of packing")
+    p.add_argument("--no-recursive", action="store_true",
+                   help="only pack images directly under the root")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this many pixels")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--color", type=int, default=1, choices=[0, 1])
+    p.add_argument("--num-thread", type=int, default=4)
+    args = p.parse_args(argv)
+    if args.list:
+        out, classes = make_list(args.prefix, args.root,
+                                 recursive=not args.no_recursive,
+                                 train_ratio=args.train_ratio,
+                                 shuffle=not args.no_shuffle)
+        print("im2rec: wrote %s (%d classes)" % (out, len(classes)))
+    else:
+        pack(args.prefix, args.root, num_workers=args.num_thread,
+             resize=args.resize, quality=args.quality, color=args.color)
+
+
+if __name__ == "__main__":
+    main()

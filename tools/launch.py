@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Multi-host job launcher.
+
+Parity: reference ``tools/launch.py`` → dmlc-core tracker (N21): spawns
+scheduler + N servers + N workers over ssh/mpi/sge/yarn/local with
+``DMLC_*`` env wiring.
+
+TPU-native redesign (SURVEY.md §5.8): there is no scheduler/server tier.
+A distributed job is N identical worker processes that join a JAX
+distributed runtime (coordinator = process 0) and then communicate ONLY
+through in-step XLA collectives over ICI/DCN. This launcher therefore:
+
+- ``local`` mode: forks N worker processes on this host, each with
+  ``JAX_PROCESS_ID``/``JAX_NUM_PROCESSES``/coordinator env (plus the
+  reference's ``DMLC_RANK``/``DMLC_NUM_WORKER`` names so mx.kv code
+  reads the same rank/size) — the analog of the dmlc local tracker used
+  by the nightly dist tests.
+- ``ssh`` mode: prints/executes one ssh command per host from a
+  hostfile, same env contract.
+
+Worker code calls ``mxnet_tpu.parallel.init_distributed()`` (a thin
+``jax.distributed.initialize`` wrapper reading this env).
+
+Usage:
+  python tools/launch.py -n 4 python train.py --kv-store dist_sync
+  python tools/launch.py -n 2 -H hosts.txt --launcher ssh python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def worker_env(rank, num_workers, coordinator):
+    env = dict(os.environ)
+    env.update({
+        # JAX distributed-runtime contract
+        "JAX_PROCESS_ID": str(rank),
+        "JAX_NUM_PROCESSES": str(num_workers),
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        # reference env names (mx.kv rank/size, scripts that read them)
+        "DMLC_ROLE": "worker",
+        "DMLC_RANK": str(rank),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": "0",  # PS tier deleted
+    })
+    return env
+
+
+def launch_local(num_workers, command, coordinator_port=29500):
+    coordinator = "127.0.0.1:%d" % coordinator_port
+    procs = []
+    for rank in range(num_workers):
+        procs.append(subprocess.Popen(
+            command, env=worker_env(rank, num_workers, coordinator)))
+
+    def _kill(*_):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def launch_ssh(hosts, num_workers, command, coordinator_port=29500,
+               dry_run=False):
+    coordinator = "%s:%d" % (hosts[0], coordinator_port)
+    procs = []
+    for rank in range(num_workers):
+        host = hosts[rank % len(hosts)]
+        env = worker_env(rank, num_workers, coordinator)
+        exports = " ".join(
+            "%s=%s" % (k, v) for k, v in env.items()
+            if k.startswith(("JAX_", "DMLC_")))
+        remote = "cd %s && env %s %s" % (
+            os.getcwd(), exports, " ".join(command))
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+        if dry_run:
+            print(" ".join(cmd))
+        else:
+            procs.append(subprocess.Popen(cmd))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-H", "--hostfile", default=None)
+    p.add_argument("--launcher", default="local",
+                   choices=["local", "ssh"])
+    p.add_argument("--port", type=int, default=29500)
+    p.add_argument("--dry-run", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    if args.launcher == "local":
+        return launch_local(args.num_workers, args.command, args.port)
+    with open(args.hostfile) as f:
+        hosts = [l.strip() for l in f if l.strip()]
+    return launch_ssh(hosts, args.num_workers, args.command, args.port,
+                      dry_run=args.dry_run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing (parity: reference
+example/rnn/lstm_bucketing.py — BASELINE workload 3, PTB perplexity).
+
+Reads a whitespace-tokenised text file (one sentence per line) or falls
+back to a synthetic cyclic corpus so the example runs offline. Each
+bucket is one XLA compilation; BucketingModule shares parameters across
+buckets exactly as the reference shares executor memory (SURVEY.md §3.5).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+import numpy as np
+
+from common import add_fit_args, get_context
+import mxnet_tpu as mx
+
+BUCKETS = [10, 20, 30, 40, 50, 60]
+
+
+def tokenize(path, vocab=None):
+    sentences = []
+    vocab = vocab if vocab is not None else {"<pad>": 0, "<eos>": 1}
+    with open(path) as f:
+        for line in f:
+            words = line.split()
+            ids = [vocab.setdefault(w, len(vocab)) for w in words]
+            if ids:
+                sentences.append(ids + [1])
+    return sentences, vocab
+
+
+def synthetic_corpus(vocab_size=40, n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    sentences = []
+    for _ in range(n):
+        start = rng.randint(2, vocab_size)
+        length = rng.randint(5, 45)
+        sentences.append([2 + (start - 2 + t) % (vocab_size - 2)
+                          for t in range(length)])
+    return sentences, vocab_size
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(parser)
+    parser.add_argument("--data-path", type=str, default=None)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-lstm-layers", type=int, default=2)
+    parser.add_argument("--stack-rnn", action="store_true",
+                        help="unfused LSTMCell stack instead of the fused scan RNN op")
+    parser.set_defaults(batch_size=32, num_epochs=5, lr=0.01,
+                        optimizer="adam")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    if args.data_path and os.path.exists(args.data_path):
+        sentences, vocab = tokenize(args.data_path)
+        vocab_size = len(vocab)
+    else:
+        sentences, vocab_size = synthetic_corpus()
+
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=BUCKETS)
+
+    if args.stack_rnn:
+        # unfused per-step cells (reference lstm_bucketing.py) — each
+        # bucket compiles an O(T)-node XLA program; fine for short
+        # buckets, slow to compile for long ones
+        cell = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_lstm_layers):
+            cell.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                     prefix="lstm_l%d_" % i))
+    else:
+        # FusedRNNCell → the RNN op → ONE lax.scan: compile time is
+        # O(1) in sequence length (the reference's cudnn_lstm_bucketing
+        # fast path, mapped to the TPU-native scan kernel)
+        cell = mx.rnn.FusedRNNCell(args.num_hidden,
+                                   num_layers=args.num_lstm_layers,
+                                   mode="lstm", prefix="lstm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        outputs, _ = cell.unroll(seq_len, inputs=embed,
+                                 merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=train.default_bucket_key,
+        context=get_context(args))
+    model.fit(
+        train,
+        eval_metric=mx.metric.Perplexity(ignore_label=0),
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches),
+    )
+    metric = mx.metric.Perplexity(ignore_label=0)
+    train.reset()
+    print("final train perplexity:", model.score(train, metric))

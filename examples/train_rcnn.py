@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Train Faster R-CNN end-to-end (parity: reference
+example/rcnn/train_end2end.py — BASELINE workload 4b: MutableModule +
+native Proposal + proposal_target CustomOp + ROIPooling).
+
+Runs a scaled-down backbone on synthetic variable-size images by
+default (the MutableModule rebind path); pass --backbone vgg for the
+full VGG-16 graph.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from common import get_context
+import mxnet_tpu as mx
+from mxnet_tpu.models import rcnn
+
+
+def make_batch(H, W, fs, scales, ratios, seed):
+    rng = np.random.RandomState(seed)
+    data = rng.rand(1, 3, H, W).astype(np.float32) * 0.3
+    w = rng.randint(H // 4, H // 2)
+    x, y = rng.randint(0, W - w), rng.randint(0, H - w)
+    cls = rng.randint(0, 2)
+    data[0, cls, y:y + w, x:x + w] += 0.6
+    gt = np.array([[x, y, x + w, y + w, cls]], np.float32)
+    lab, tgt, wgt = rcnn.assign_anchors(
+        gt, (H // fs, W // fs), (H, W), feature_stride=fs,
+        scales=scales, ratios=ratios, batch_size=32,
+        fg_overlap=0.5, bg_overlap=0.3)
+    return mx.io.DataBatch(
+        data=[mx.nd.array(data), mx.nd.array([[H, W, 1.0]]),
+              mx.nd.array(gt[None])],
+        label=[mx.nd.array(lab), mx.nd.array(tgt), mx.nd.array(wgt)],
+        provide_data=[("data", data.shape), ("im_info", (1, 3)),
+                      ("gt_boxes", (1,) + gt.shape)],
+        provide_label=[("rpn_label", lab.shape),
+                       ("rpn_bbox_target", tgt.shape),
+                       ("rpn_bbox_weight", wgt.shape)])
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--backbone", default="tiny",
+                        choices=["tiny", "vgg"])
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--lr", type=float, default=0.005)
+    parser.add_argument("--ctx", type=str, default="cpu")
+    parser.add_argument("--num-devices", type=int, default=1)
+    args = parser.parse_args()
+    ctx = get_context(args)  # FIRST: routes jax to cpu before any nd use
+
+    tiny = args.backbone == "tiny"
+    fs = 4 if tiny else 16
+    scales = (2, 4) if tiny else (8, 16, 32)
+    ratios = (1.0,) if tiny else (0.5, 1, 2)
+    num_classes = 3
+    net = rcnn.get_symbol_train(
+        num_classes=num_classes, backbone=args.backbone,
+        feature_stride=fs, scales=scales, ratios=ratios,
+        rpn_batch_size=32, batch_rois=16 if tiny else 128,
+        rpn_pre_nms_top_n=64 if tiny else 6000,
+        rpn_post_nms_top_n=16 if tiny else 300,
+        rpn_min_size=2 if tiny else 16,
+        pooled_size=(3, 3) if tiny else (7, 7),
+        hidden=64 if tiny else 1024)
+
+    sizes = [(32, 32), (32, 48), (48, 32)] if tiny else [(600, 800)]
+    b0 = make_batch(*sizes[0], fs, scales, ratios, 0)
+    max_h = max(s[0] for s in sizes)
+    max_w = max(s[1] for s in sizes)
+    fh, fw = max_h // fs, max_w // fs
+    A = len(scales) * len(ratios)
+    mod = mx.mod.MutableModule(
+        net, data_names=("data", "im_info", "gt_boxes"),
+        label_names=("rpn_label", "rpn_bbox_target", "rpn_bbox_weight"),
+        context=ctx,
+        max_data_shapes=[("data", (1, 3, max_h, max_w))],
+        max_label_shapes=[("rpn_label", (1, A * fh, fw)),
+                          ("rpn_bbox_target", (1, 4 * A, fh, fw)),
+                          ("rpn_bbox_weight", (1, 4 * A, fh, fw))])
+    mod.bind(data_shapes=b0.provide_data, label_shapes=b0.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr})
+    for step in range(args.steps):
+        batch = make_batch(*sizes[step % len(sizes)], fs, scales, ratios,
+                           step)
+        mod.forward(batch, is_train=True)
+        outs = [o.asnumpy() for o in mod.get_outputs()]
+        mod.backward()
+        mod.update()
+        if step % 10 == 0:
+            rpn_prob, rpn_loss, cls_prob, bbox_loss, _ = outs
+            print("step %d rpn_bbox_loss %.4f bbox_loss %.4f"
+                  % (step, rpn_loss.sum(), bbox_loss.sum()))
+    print("rcnn example done (%d distinct shapes compiled)"
+          % len(mod._shape_modules))

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Train SSD-300 detection (parity: reference example/ssd/train/train_net.py
+— BASELINE workload 4a, the multi-output executor).
+
+With --data-train pointing at an ImageDetRecordIter-style .rec the full
+VGG16-SSD-300 trains; without data a tiny synthetic detection set runs a
+scaled-down head so the example works offline.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from common import add_fit_args, get_context
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd
+
+
+def synthetic_det_batches(batch_size, num_batches=8, size=64, seed=0):
+    """[B,3,S,S] images with one bright square per image; label rows
+    (cls, x1, y1, x2, y2) normalized."""
+    rng = np.random.RandomState(seed)
+    batches = []
+    for _ in range(num_batches):
+        data = rng.rand(batch_size, 3, size, size).astype(np.float32) * 0.2
+        label = -np.ones((batch_size, 4, 5), np.float32)
+        for b in range(batch_size):
+            w = rng.randint(size // 4, size // 2)
+            x = rng.randint(0, size - w)
+            y = rng.randint(0, size - w)
+            cls = rng.randint(0, 2)
+            data[b, cls, y:y + w, x:x + w] += 0.7
+            label[b, 0] = [cls, x / size, y / size, (x + w) / size,
+                           (y + w) / size]
+        batches.append(mx.io.DataBatch(
+            data=[mx.nd.array(data)], label=[mx.nd.array(label)],
+            provide_data=[("data", data.shape)],
+            provide_label=[("label", label.shape)]))
+    return batches
+
+
+def tiny_ssd(num_classes):
+    data = mx.sym.Variable("data")
+    body = data
+    sources = []
+    for k, nf in enumerate((16, 32)):
+        body = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  stride=(2, 2), num_filter=nf,
+                                  name="c%d" % k)
+        body = mx.sym.Activation(body, act_type="relu")
+        sources.append(body)
+    loc, cls, anchors = ssd.multibox_layer(
+        sources, num_classes, sizes=[(0.3, 0.4), (0.6, 0.8)],
+        ratios=[(1, 2, 0.5)] * 2, normalization=[-1, -1])
+    return ssd.training_head(loc, cls, anchors, num_classes)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(parser)
+    parser.add_argument("--data-train", type=str, default=None)
+    parser.add_argument("--num-classes", type=int, default=20)
+    parser.set_defaults(batch_size=8, num_epochs=2, lr=0.05, ctx="cpu")
+    args = parser.parse_args()
+
+    if args.data_train:
+        net = ssd.get_symbol_train(num_classes=args.num_classes)
+        train = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=(3, 300, 300),
+            batch_size=args.batch_size, shuffle=True, label_width=20)
+        mod = mx.mod.Module(net, data_names=("data",),
+                            label_names=("label",),
+                            context=get_context(args))
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": args.lr,
+                                  "momentum": args.mom, "wd": args.wd},
+                eval_metric=ssd.MultiBoxMetric(),
+                num_epoch=args.num_epochs)
+    else:
+        num_classes = 2
+        net = tiny_ssd(num_classes)
+        batches = synthetic_det_batches(args.batch_size)
+        mod = mx.mod.Module(net, data_names=("data",),
+                            label_names=("label",),
+                            context=get_context(args))
+        mod.bind(data_shapes=batches[0].provide_data,
+                 label_shapes=batches[0].provide_label)
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": args.lr})
+        metric = ssd.MultiBoxMetric()
+        for epoch in range(args.num_epochs):
+            metric.reset()
+            for batch in batches:
+                mod.forward(batch, is_train=True)
+                mod.update_metric(metric, batch.label)
+                mod.backward()
+                mod.update()
+            print("epoch %d %s" % (epoch, metric.get_name_value()))

@@ -309,7 +309,12 @@ class FusedRNN(Initializer):
         )
         args = cell.unpack_weights({cell._parameter.name: arr})
         for name, a in args.items():
-            desc2 = InitDesc(name, getattr(desc, "attrs", {}))
+            # strip the blob's own __init__ attr: the unpacked slices
+            # must dispatch by NAME (i2h/h2h/bias), not recurse into
+            # this FusedRNN initializer again
+            attrs = dict(getattr(desc, "attrs", {}) or {})
+            attrs.pop("__init__", None)
+            desc2 = InitDesc(name, attrs)
             if self._init is None:
                 getattr(desc, "global_init", Uniform())(desc2, a)
             else:

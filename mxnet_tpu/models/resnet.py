@@ -59,11 +59,16 @@ def residual_unit(data, num_filter, stride, dim_match, name,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9):
+           bottle_neck=True, bn_mom=0.9, dtype="float32"):
     data = sym.Variable("data")
     (nchannel, height, width) = image_shape
     data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
                          name="bn_data")
+    if dtype != "float32":
+        # reference resnet_fp16.py pattern: cast after the input BN, cast
+        # back before the loss head; infer_type then makes every weight
+        # in between reduced-precision (bf16 on the MXU)
+        data = sym.Cast(data, dtype=dtype, name="cast_in")
     if height <= 32:  # cifar
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
@@ -93,11 +98,13 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
                         pool_type="avg", name="pool1")
     flat = sym.Flatten(pool1)
     fc1 = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    if dtype != "float32":
+        fc1 = sym.Cast(fc1, dtype="float32", name="cast_out")
     return sym.SoftmaxOutput(fc1, name="softmax")
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
-               **kwargs):
+               dtype="float32", **kwargs):
     """Parity with the reference CLI surface: --num-layers picks depth."""
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
@@ -138,5 +145,5 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
     return resnet(
         units=units, num_stages=num_stages, filter_list=filter_list,
         num_classes=num_classes, image_shape=image_shape,
-        bottle_neck=bottle_neck,
+        bottle_neck=bottle_neck, dtype=dtype,
     )

@@ -10,8 +10,10 @@ softmax_cross_entropy (``loss_binary_op.cc``).
 
 TPU-native notes:
 - Convolution/FullyConnected lower to ``lax.conv_general_dilated`` /
-  ``lax.dot_general`` → the MXU; fp32 accumulation is forced via
-  ``preferred_element_type`` so bf16 training matches reference fp32 curves.
+  ``lax.dot_general`` → the MXU. FullyConnected forces fp32 accumulation
+  via ``preferred_element_type``; convolutions rely on the MXU's native
+  fp32 accumulation of bf16 matmuls (an explicit f32 output + cast breaks
+  lax's conv transpose rules under bf16).
 - The stateless/stateful split of the reference (OperatorProperty holding
   cuDNN descriptors) disappears: XLA owns algorithm choice, so every layer
   here is a pure function; BatchNorm's moving stats are threaded as aux
@@ -212,6 +214,9 @@ def _convolution(attrs, ins, is_train):
     nd = len(kernel)
     groups = int(attrs.get("num_group", 1))
     data, weight = ins[0], ins[1]
+    # NOTE: no preferred_element_type here — the MXU accumulates bf16
+    # matmuls in fp32 natively, and an explicit f32 output + cast breaks
+    # lax's conv transpose rules under bf16 (mixed-dtype cotangent)
     out = jax.lax.conv_general_dilated(
         data,
         weight,
@@ -220,8 +225,7 @@ def _convolution(attrs, ins, is_train):
         rhs_dilation=dilate,
         dimension_numbers=_conv_dn(nd),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not bool(attrs.get("no_bias", False)):
         bias = ins[2].reshape((1, -1) + (1,) * nd)
         out = out + bias
@@ -298,8 +302,7 @@ def _deconvolution(attrs, ins, is_train):
         dimension_numbers=_conv_dn(nd),
         transpose_kernel=True,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not bool(attrs.get("no_bias", True)):
         out = out + ins[2].reshape((1, -1) + (1,) * nd)
     return [out]

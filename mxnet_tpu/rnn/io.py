@@ -61,7 +61,12 @@ class BucketSentenceIter(DataIter):
             buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[: len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
+        # reshape keeps empty buckets 2-D ((0, len)) so reset()'s label
+        # shifting indexes them uniformly
+        self.data = [
+            np.asarray(i, dtype=dtype).reshape(-1, b)
+            for i, b in zip(self.data, buckets)
+        ]
         print("WARNING: discarded %d sentences longer than the largest bucket." % ndiscard)
 
         self.batch_size = batch_size

@@ -1,31 +1,171 @@
 """Benchmark: ResNet-50 training throughput (images/sec) on one chip.
 
 Baseline (BASELINE.md): reference MXNet 0.9.5 trains ResNet-50 ImageNet at
-109 img/s on 1x K80 (batch 32). This bench runs the SAME workload shape —
+109 img/s on 1x K80 (batch 32). This bench runs the SAME workload shape --
 ResNet-50, batch 32, 3x224x224, full training step (forward + backward +
-SGD-momentum update) — as one fused XLA program on the available
+SGD-momentum update) -- as one fused XLA program on the available
 accelerator, and reports images/sec with vs_baseline = value / 109.
 
-Prints exactly ONE JSON line.
+Prints exactly ONE JSON line on stdout -- always, even on failure (the
+round-1 run died with rc=1 and zero diagnostics; every stage is now
+reported on stderr and a failure still emits a parseable JSON line).
+Stages: backend-init (subprocess probe with a hard timeout, then a
+thread-guarded in-process init; the axon TPU plugin can hang in native
+code instead of erroring, which no in-process signal can interrupt) ->
+build -> compile -> warmup -> measure. If the TPU backend is unreachable
+the bench falls back to a shortened CPU run and says so in the JSON
+rather than producing nothing.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
 BASELINE_IMG_S = 109.0  # reference resnet-50 batch-32 on K80
-BATCH = 32
-STEPS = 20
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 WARMUP = 3
+# Whole-bench deadline math: the round-1 harness killed a re-run at
+# ~560s, so the pre-fallback budget (retries * probe timeout) must leave
+# room for the CPU fallback's compile + shortened measurement.
+INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT", "120"))
+INIT_RETRIES = 2
+METRIC = "resnet50_train_images_per_sec_batch%d" % BATCH
+
+# bf16 peak TFLOP/s per chip by TPU generation (for MFU reporting).
+_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+_stage = "start"
+
+
+def log(msg):
+    print("[bench] %s" % msg, file=sys.stderr, flush=True)
+
+
+def stage(name):
+    global _stage
+    _stage = name
+    log("stage: %s" % name)
+
+
+def emit(payload):
+    print(json.dumps(payload), flush=True)
+
+
+def fail(exc):
+    emit({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0 if BATCH == 32 else None,
+        "error": "%s: %s" % (type(exc).__name__, str(exc)[:500]),
+        "stage": _stage,
+    })
+    traceback.print_exc(file=sys.stderr)
+    sys.exit(0)
+
+
+def _probe_backend_subprocess(timeout_s):
+    """Probe accelerator init in a SUBPROCESS so a hang is killable.
+
+    The axon plugin's client init is a blocking native call: a SIGALRM
+    in-process would only be delivered after it returns (i.e. never when
+    the tunnel is wedged). A subprocess with a hard timeout is the only
+    interruptible probe. Returns platform string or None."""
+    code = ("import jax\n"
+            "d = jax.devices()\n"
+            "print('PROBE_OK %d %s' % (len(d), d[0].platform), flush=True)\n")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            return line.split()[2]
+    log("probe rc=%d stderr tail: %s" % (r.returncode, r.stderr[-300:]))
+    return None
+
+
+def _guarded_devices(jax, timeout_s):
+    """Init backends in a daemon thread with a join timeout.
+
+    The subprocess probe only proves init worked once; the in-process
+    init could still wedge on a flaky tunnel. A hung native call cannot
+    be cancelled -- on timeout the caller emits the failure JSON and
+    exits, honoring the one-JSON-line contract instead of hanging."""
+    import threading
+
+    box = {}
+
+    def _init():
+        try:
+            box["devs"] = jax.devices()
+        except Exception as e:
+            box["err"] = e
+
+    t = threading.Thread(target=_init, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError("in-process backend init hung > %ds" % timeout_s)
+    if "err" in box:
+        raise box["err"]
+    return box["devs"]
+
+
+def init_backend():
+    """Initialize an accelerator backend with retries; fall back to CPU.
+
+    Returns (jax, platform_name, fell_back). Each attempt probes in a
+    subprocess (hang-proof); only after a successful probe do we init the
+    backend in-process, itself thread-guarded. Retries cover transient
+    tunnel setup errors (the round-1 failure mode)."""
+    stage("backend-init")
+    import jax
+
+    for attempt in range(1, INIT_RETRIES + 1):
+        plat = _probe_backend_subprocess(INIT_TIMEOUT_S)
+        if plat is not None:
+            devs = _guarded_devices(jax, INIT_TIMEOUT_S)
+            log("backend up: %d x %s (attempt %d)" % (len(devs), plat, attempt))
+            return jax, devs[0].platform, False
+        log("backend init attempt %d failed: probe timeout/error (%ds)"
+            % (attempt, INIT_TIMEOUT_S))
+        time.sleep(2)
+    # Accelerator unreachable -- fall back to CPU so a number exists.
+    # The CPU backend has not been touched yet, so the platform override
+    # still applies in-process.
+    log("falling back to CPU after %d failed attempts" % INIT_RETRIES)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    devs = jax.devices("cpu")
+    return jax, "cpu (accelerator probe failed %dx%ds)" % (
+        INIT_RETRIES, INIT_TIMEOUT_S), True
 
 
 def main():
-    import jax
+    global STEPS, WARMUP
+    jax, platform, fell_back = init_backend()
+    if fell_back:
+        # Shorten the run so the fallback number lands inside the harness
+        # kill window (ResNet-50 steps on CPU are ~tens of seconds each).
+        STEPS = min(STEPS, 2)
+        WARMUP = 1
+        log("CPU fallback: shortened to %d warmup + %d steps" % (WARMUP, STEPS))
     import jax.numpy as jnp
 
+    stage("build")
     from mxnet_tpu.executor import _GraphProgram
     from mxnet_tpu.models.resnet import get_symbol
 
@@ -37,7 +177,6 @@ def main():
     )
     arg_names = sym.list_arguments()
     aux_names = sym.list_auxiliary_states()
-    param_names = [n for n in arg_names if n not in ("data", "softmax_label")]
 
     rng = np.random.RandomState(0)
     params = {}
@@ -86,24 +225,65 @@ def main():
     moms = {k: jnp.asarray(v) for k, v in moms.items()}
     aux = {k: jnp.asarray(v) for k, v in aux.items()}
 
-    for _ in range(WARMUP):
-        params, moms, aux = step(params, moms, aux, data, label)
+    stage("compile")
+    t0 = time.perf_counter()
+    flops_per_step = None
+    try:
+        # AOT-compile once and run THROUGH the compiled executable (a
+        # separate step() call would miss jit's dispatch cache and compile
+        # the whole fwd+bwd graph a second time).
+        compiled = step.lower(params, moms, aux, data, label).compile()
+        run = compiled
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            flops_per_step = float(ca.get("flops", 0.0)) or None
+        except Exception as e:
+            log("cost_analysis unavailable: %s" % e)
+        log("compiled in %.1fs" % (time.perf_counter() - t0))
+    except Exception as e:
+        # lower/compile path failed; fall back to tracing via first call
+        log("explicit compile failed (%s); relying on first-call jit" % e)
+        run = step
+
+    stage("warmup")
+    for i in range(WARMUP):
+        params, moms, aux = run(params, moms, aux, data, label)
+        log("warmup step %d done" % i)
     jax.tree_util.tree_map(lambda x: x.block_until_ready(), params)
 
+    stage("measure")
     t0 = time.perf_counter()
     for _ in range(STEPS):
-        params, moms, aux = step(params, moms, aux, data, label)
+        params, moms, aux = run(params, moms, aux, data, label)
     jax.tree_util.tree_map(lambda x: x.block_until_ready(), params)
     dt = time.perf_counter() - t0
 
     img_s = BATCH * STEPS / dt
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_batch32",
+    out = {
+        "metric": METRIC,
         "value": round(img_s, 2),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+        "platform": platform,
+        "step_ms": round(1000.0 * dt / STEPS, 2),
+    }
+    # vs_baseline only comparable at the reference's batch size
+    out["vs_baseline"] = (
+        round(img_s / BASELINE_IMG_S, 3) if BATCH == 32 else None
+    )
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if flops_per_step and gen in _PEAK_TFLOPS and platform.startswith(("tpu", "axon")):
+        mfu = (flops_per_step * STEPS / dt) / (_PEAK_TFLOPS[gen] * 1e12)
+        out["mfu"] = round(mfu, 4)
+        out["tflops_per_step"] = round(flops_per_step / 1e12, 3)
+    emit(out)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 -- always emit the JSON line
+        fail(e)

@@ -7,17 +7,15 @@ The jax.config update routes around any accelerator plugin so the suite
 never depends on TPU availability.
 """
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _force_cpu_mesh_platform
+
+_force_cpu_mesh_platform(8)
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -64,6 +64,19 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        # fused mesh path (kvstore 'device'/'dist_device_sync'): the whole
+        # train step — fwd, bwd, psum grad sync, optimizer — is ONE XLA
+        # program over a dp Mesh (ShardedTrainStep), replacing the
+        # per-device executor + kvstore push/pull hot loop.
+        self._fused_trainer = None
+        self._fused_owner = None  # module owning the sharded state dicts
+        self._fused_params = None
+        self._fused_aux = None
+        self._fused_opt = None
+        self._fused_batch = None
+        self._fused_outputs = None
+        self._fused_t = 0
+        self._fused_exec_stale = False
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -279,32 +292,150 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
+        if self._fusable(kvstore):
+            self._init_fused()
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    # -- fused mesh path ------------------------------------------------
+    def _fusable(self, kvstore):
+        """kvstore 'device'/'dist_device_sync' routes training through the
+        fused ShardedTrainStep (SURVEY §5.8: device-side reduce ≡ in-XLA
+        allreduce over the mesh). The executor-group path remains for
+        inference, input grads, and the 'local' kvstore."""
+        return (
+            kvstore is not None
+            and "device" in kvstore.type
+            and self.for_training
+            and not self.inputs_need_grad
+            and not self._fixed_param_names
+            and self._exec_group.batch_size % len(self._context) == 0
+        )
+
+    def _init_fused(self):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from ..parallel.train_step import ShardedTrainStep
+
+        devices = [c.jax_device for c in self._context]
+        mesh = Mesh(np.asarray(devices), ("dp",))
+        self._fused_trainer = ShardedTrainStep(
+            self._symbol, mesh, optimizer=self._optimizer,
+            data_names=self._data_names, label_names=self._label_names,
+        ).compile()
+        self._fused_owner = self
+        self._fused_params, self._fused_aux = self._fused_trainer.place_params(
+            self._arg_params, self._aux_params
+        )
+        self._fused_opt = self._fused_trainer.make_state(self._fused_params)
+        self._fused_t = 0
+        self._fused_exec_stale = False
+
+    def _make_fused_batch(self, data_batch):
+        import jax
+        import numpy as np
+
+        sharding = self._fused_trainer.batch_sharding()
+        batch = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            batch[name] = jax.device_put(arr.asnumpy(), sharding)
+        if self._label_names and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                batch[name] = jax.device_put(arr.asnumpy(), sharding)
+        return batch
+
+    def _ensure_exec_params(self):
+        """Refresh executor-group weight copies after fused updates (the
+        eval/predict path still runs per-device executors)."""
+        if self._fused_trainer is not None and self._fused_exec_stale:
+            self._sync_params_from_devices()
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+            self._fused_exec_stale = False
+
     def borrow_optimizer(self, shared_module):
-        """Parity module.py:529."""
+        """Parity module.py:529. When the shared module runs the fused
+        mesh path, this module joins it: same optimizer, and the sharded
+        param/aux/opt-state dicts live on the OWNER so every borrower
+        (e.g. BucketingModule children, which share param names) sees
+        each other's updates."""
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        if shared_module._fused_trainer is not None:
+            from ..parallel.train_step import ShardedTrainStep
+
+            owner = shared_module._fused_owner or shared_module
+            self._fused_owner = owner
+            self._fused_trainer = ShardedTrainStep(
+                self._symbol, shared_module._fused_trainer.mesh,
+                optimizer=self._optimizer,
+                data_names=self._data_names, label_names=self._label_names,
+            ).compile()
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if (self._fused_trainer is not None
+                and (is_train is None or is_train) and self.for_training):
+            # defer: the fused step runs fwd+bwd+update at update()
+            self._fused_batch = data_batch
+            self._fused_outputs = None
+            return
+        # executor path (eval/predict): drop any stale fused outputs so
+        # get_outputs/update_metric serve THIS forward's results
+        self._fused_outputs = None
+        self._fused_batch = None
+        self._ensure_exec_params()
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        if self._fused_trainer is not None and self._fused_batch is not None:
+            assert out_grads is None, \
+                "fused path computes gradients in update()"
+            return
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
         """Parity module.py:553."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        if self._fused_trainer is not None:
+            assert self._fused_batch is not None, "forward() before update()"
+            owner = self._fused_owner
+            batch = self._make_fused_batch(self._fused_batch)
+            optm = self._optimizer
+            owner._fused_t += 1
+            optm.num_update = max(owner._fused_t, optm.num_update)
+            # One scheduled lr per step for ALL params. (The reference's
+            # per-param Updater staggers scheduler transitions by one
+            # batch for the first param — an artifact of interleaving
+            # _get_lr/_update_count across params, not a spec; the fused
+            # step uses the post-increment count like params 1..N-1 do.)
+            lr = (optm.lr_scheduler(optm.num_update)
+                  if optm.lr_scheduler is not None else optm.lr)
+            # borrowed trainers lazily adopt the owner's state for any
+            # params this symbol shares; missing opt-state entries are
+            # created on first use
+            if self is not owner and self._fused_params is None:
+                self._fused_params = owner._fused_params
+                self._fused_aux = owner._fused_aux
+                self._fused_opt = owner._fused_opt
+            p, a, s, outs = self._fused_trainer(
+                owner._fused_params, owner._fused_aux, owner._fused_opt,
+                batch, lr=lr, t=owner._fused_t,
+            )
+            owner._fused_params, owner._fused_aux, owner._fused_opt = p, a, s
+            self._fused_outputs = [nd.NDArray(o) for o in outs]
+            self._fused_batch = None
+            owner._fused_exec_stale = True
+            self._fused_exec_stale = True
+            return
         if self._update_on_kvstore:
             _update_params_on_kvstore(
                 self._exec_group.param_arrays, self._exec_group.grad_arrays,
@@ -319,6 +450,17 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_trainer is not None:
+            if self._fused_outputs is not None:
+                return self._fused_outputs
+            if self._fused_batch is not None:
+                # forward() was deferred and update() has not run yet:
+                # serve outputs through the executor path
+                self._ensure_exec_params()
+                self._exec_group.forward(self._fused_batch, True)
+                return self._exec_group.get_outputs(
+                    merge_multi_context=merge_multi_context
+                )
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -326,16 +468,49 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._fused_trainer is not None and self._fused_outputs is not None:
+            eval_metric.update(labels, self._fused_outputs)
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
         """Parity module.py:666."""
+        if self._fused_trainer is not None:
+            import numpy as np
+
+            owner = self._fused_owner
+            for name, arr in owner._fused_params.items():
+                if name in self._arg_params:
+                    self._arg_params[name][:] = np.asarray(arr)
+            for name, arr in owner._fused_aux.items():
+                if name in self._aux_params:
+                    self._aux_params[name][:] = np.asarray(arr)
+            self._params_dirty = False
+            return
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
         """Parity module.py:674."""
         assert self.optimizer_initialized
+        if self._fused_trainer is not None:
+            import pickle
+
+            import numpy as np
+
+            owner = self._fused_owner
+
+            def _host(s):
+                if s is None:
+                    return None
+                if isinstance(s, tuple):
+                    return tuple(_host(x) for x in s)
+                return np.asarray(s)
+
+            state = {k: _host(v) for k, v in owner._fused_opt.items()}
+            with open(fname, "wb") as fout:
+                pickle.dump({"t": owner._fused_t, "state": state}, fout)
+            return
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -344,6 +519,30 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        if self._fused_trainer is not None:
+            import pickle
+
+            import jax
+
+            owner = self._fused_owner
+            with open(fname, "rb") as fin:
+                blob = pickle.load(fin)
+            owner._fused_t = blob["t"]
+            trainer = owner._fused_trainer
+
+            def _place(name, s):
+                if s is None:
+                    return None
+                if isinstance(s, tuple):
+                    return tuple(_place(name, x) for x in s)
+                return jax.device_put(
+                    s, trainer._state_sharding_for(name, s)
+                )
+
+            owner._fused_opt = {
+                k: _place(k, v) for k, v in blob["state"].items()
+            }
+            return
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:

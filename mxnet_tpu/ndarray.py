@@ -89,7 +89,6 @@ def imperative_invoke(opdef, inputs, attrs, out=None):
         opdef = _registry.get(opdef)
     attrs = opdef.canon_attrs(attrs)
     is_train = _autograd.is_training()
-    attr_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
     rng = _random.next_key() if opdef.needs_rng else None
     arrays = []
     for x in inputs:
@@ -97,8 +96,23 @@ def imperative_invoke(opdef, inputs, attrs, out=None):
             arrays.append(x._data)
         else:
             arrays.append(np.asarray(x))
-    fn = _compiled_op(opdef.name, attr_key, is_train, opdef.needs_rng)
-    results = fn(rng, *arrays)
+    from jax.core import Tracer
+
+    if any(isinstance(a, Tracer) for a in arrays) or any(
+        isinstance(v, Tracer) for v in attrs.values()
+    ):
+        # Already inside an outer jit trace (e.g. ShardedTrainStep tracing
+        # through Optimizer.update): call fcompute inline — no per-op jit
+        # cache (tracers are unhashable) and attrs may be traced scalars
+        # (lr/wd enter the fused step as per-call inputs).
+        run_attrs = dict(attrs)
+        if opdef.needs_rng:
+            run_attrs["__rng__"] = rng
+        results = tuple(opdef.fcompute(run_attrs, list(arrays), is_train))
+    else:
+        attr_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+        fn = _compiled_op(opdef.name, attr_key, is_train, opdef.needs_rng)
+        results = fn(rng, *arrays)
     # Trailing results map to reference-mutated inputs: explicit
     # mutate_inputs (sgd_mom_update's momentum) or aux states (BatchNorm's
     # moving_mean/var, which the reference mutates via FMutateInputs).
@@ -276,6 +290,11 @@ class NDArray:
             return imperative_invoke(name, [self], {"scalar": float(other)})
         if isinstance(other, np.ndarray):
             return self._binop(array(other, ctx=self.context, dtype=self.dtype), op, scalar_op, reverse)
+        jax = _jax()
+        if isinstance(other, (jax.Array, jax.core.Tracer)):
+            # jax values (incl. traced scalars like the fused step's lr)
+            # participate directly as NDArray operands
+            return self._binop(NDArray(other), op, scalar_op, reverse)
         return NotImplemented
 
     def __add__(self, o):

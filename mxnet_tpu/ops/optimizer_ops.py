@@ -16,12 +16,21 @@ from .registry import OpDef, register
 
 
 def _prep_grad(weight, grad, attrs):
-    g = grad * float(attrs.get("rescale_grad", 1.0))
+    g = grad * _f(attrs.get("rescale_grad", 1.0))
     clip = attrs.get("clip_gradient", -1.0)
     if clip is not None and float(clip) > 0:
         g = jnp.clip(g, -float(clip), float(clip))
-    return g + float(attrs.get("wd", 0.0)) * weight
+    return g + _f(attrs.get("wd", 0.0)) * weight
 
+
+
+def _f(v):
+    """Attr as multiplier: host floats stay floats; traced scalars pass
+    through (lr/wd enter the fused ShardedTrainStep as per-call inputs)."""
+    try:
+        return float(v)
+    except TypeError:
+        return v
 
 _COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0}
 
@@ -29,7 +38,7 @@ _COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0}
 def _sgd_update(attrs, ins, is_train):
     weight, grad = ins
     g = _prep_grad(weight, grad, attrs)
-    return [weight - float(attrs["lr"]) * g]
+    return [weight - _f(attrs["lr"]) * g]
 
 
 register(
@@ -45,7 +54,7 @@ register(
 def _sgd_mom_update(attrs, ins, is_train):
     weight, grad, mom = ins
     g = _prep_grad(weight, grad, attrs)
-    new_mom = float(attrs.get("momentum", 0.0)) * mom - float(attrs["lr"]) * g
+    new_mom = _f(attrs.get("momentum", 0.0)) * mom - _f(attrs["lr"]) * g
     return [weight + new_mom, new_mom]
 
 
@@ -68,7 +77,7 @@ def _adam_update(attrs, ins, is_train):
     g = _prep_grad(weight, grad, attrs)
     new_mean = beta1 * mean + (1.0 - beta1) * g
     new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
-    new_w = weight - float(attrs["lr"]) * new_mean / (jnp.sqrt(new_var) + eps)
+    new_w = weight - _f(attrs["lr"]) * new_mean / (jnp.sqrt(new_var) + eps)
     return [new_w, new_mean, new_var]
 
 
@@ -89,7 +98,7 @@ def _rmsprop_update(attrs, ins, is_train):
     eps = float(attrs.get("epsilon", 1e-8))
     g = _prep_grad(weight, grad, attrs)
     new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
-    delta = -float(attrs["lr"]) * g / jnp.sqrt(new_n + eps)
+    delta = -_f(attrs["lr"]) * g / jnp.sqrt(new_n + eps)
     cw = attrs.get("clip_weights", -1.0)
     new_w = weight + delta
     if cw is not None and float(cw) > 0:
@@ -116,7 +125,7 @@ def _rmspropalex_update(attrs, ins, is_train):
     g = _prep_grad(weight, grad, attrs)
     new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
     new_g = (1.0 - gamma1) * g + gamma1 * g_avg
-    new_delta = gamma2 * delta - float(attrs["lr"]) * g / jnp.sqrt(
+    new_delta = gamma2 * delta - _f(attrs["lr"]) * g / jnp.sqrt(
         new_n - jnp.square(new_g) + eps
     )
     new_w = weight + new_delta

@@ -187,7 +187,7 @@ class SGLD(Optimizer):
             grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
         from . import random as _rnd
 
-        noise = _rnd.normal(0, math.sqrt(lr), shape=weight.shape)
+        noise = _rnd.normal(0, lr ** 0.5, shape=weight.shape)
         weight += -lr / 2 * (grad + wd * weight) + noise
 
 
@@ -263,7 +263,9 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        # ** 0.5 (not math.sqrt) so this also traces when t/lr are jax
+        # scalars inside the fused ShardedTrainStep program
+        lr *= coef2 ** 0.5 / coef1
         mean, var = state
         nd.adam_update(
             weight, grad, mean, var, out=weight,

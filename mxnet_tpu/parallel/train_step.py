@@ -1,42 +1,83 @@
 """Sharded training step: the fused TPU path for Module training.
 
 This is the TPU-native replacement for the reference's §3.1 hot loop
-(per-device executors + KVStore push/pull): the ENTIRE step — forward,
-backward, gradient allreduce, optimizer update — compiles to one XLA
-program over a Mesh:
+(per-device executors + KVStore push/pull, python/mxnet/module/module.py:432-553
++ model.py:88-117): the ENTIRE step — forward, backward, gradient
+allreduce, optimizer update — compiles to one XLA program over a Mesh:
 
 - batch sharded over ``dp`` (DataParallelExecutorGroup.decide_slices →
-  PartitionSpec('dp'))
+  jax.sharding with PartitionSpec('dp'))
 - params replicated over dp, optionally sharded over ``tp``
   (PlaceDevice/ctx_group → PartitionSpec)
 - gradient sync = psum over ICI, inserted by GSPMD from the shardings
   (KVStore device/dist_device_sync → in-XLA allreduce; the reference's
   priority-ordered push overlap becomes XLA latency-hiding scheduling)
-- optimizer state sharded over dp (ZeRO / "Automatic Cross-Replica
-  Sharding of Weight Update", PAPERS.md)
+- optimizer state optionally sharded over dp (ZeRO-1 / "Automatic
+  Cross-Replica Sharding of Weight Update", PAPERS.md)
+
+The optimizer update is NOT re-implemented here: the step function
+traces straight through ``Optimizer.update`` of ANY registered optimizer
+(reference python/mxnet/optimizer.py surface) by wrapping the traced
+jax values in NDArrays — the imperative op layer nests fine under jit.
+Step-dependent quantities (learning rate after scheduling, update count
+``t`` for Adam-style bias correction) enter the compiled program as
+traced scalars so one compilation serves every step.
 """
 from __future__ import annotations
-
-import functools
 
 import numpy as np
 
 
+class _EveryKeyCount(dict):
+    """Stand-in for Optimizer._index_update_count during tracing: every
+    parameter reads the SAME traced step counter ``t`` (the fused step
+    updates all params exactly once per step, so the per-index counts
+    the reference tracks are all equal to t here)."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, key):
+        return self._t
+
+    def __setitem__(self, key, value):
+        pass
+
+    def __contains__(self, key):
+        return True
+
+
+def _wrap_state(state, NDArray):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_wrap_state(s, NDArray) for s in state)
+    return NDArray(state)
+
+
+def _unwrap_state(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_unwrap_state(s) for s in state)
+    return state._data
+
+
 class ShardedTrainStep:
-    """Compile a Symbol's train step over a Mesh.
+    """Compile a Symbol's full train step over a Mesh.
 
     Wraps the same _GraphProgram the Executor uses, but jits it with
     sharding constraints instead of per-device loops. Loss convention:
-    mean over the global batch of the first output (the *Output loss heads
-    carry their own backward, so we drive vjp with ones like the Executor
-    does).
+    sum of outputs drives the vjp (the *Output loss heads carry their own
+    backward, like Executor.backward); the optimizer's rescale_grad
+    normalizes by global batch exactly as the reference's updater does.
     """
 
     def __init__(self, symbol, mesh, optimizer=None, param_specs=None,
                  data_names=("data",), label_names=("softmax_label",),
-                 dtype=None, zero1=True):
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+                 dtype=None, zero1=False):
+        from jax.sharding import PartitionSpec as P
 
         from ..executor import _GraphProgram
 
@@ -52,13 +93,20 @@ class ShardedTrainStep:
             n for n in self.arg_names
             if n not in self.data_names + self.label_names
         ]
+        # ZeRO-1: shard otherwise-replicated optimizer state over dp when
+        # the leading dim divides evenly (opt-in: changes layout only, not
+        # numerics — each dp rank updates its state shard then the
+        # all-gather is implicit in the next step's reads).
         self.zero1 = zero1
         # parameter shardings: default replicated; caller may pass
         # name -> PartitionSpec (tp-sharded layers)
         self.param_specs = dict(param_specs or {})
-        self._mesh_axes = mesh.axis_names
         self._batch_spec = P("dp")
         self._step = None
+        self._needs_rng = any(
+            (not n.is_variable) and n.op.needs_rng
+            for n in self.program.nodes
+        )
 
     # ------------------------------------------------------------------
     def _spec_for(self, name):
@@ -66,14 +114,76 @@ class ShardedTrainStep:
 
         return self.param_specs.get(name, P())
 
-    def init(self, arg_shapes_by_name, initializer, seed=0):
-        """Allocate + initialize sharded params/opt-state on the mesh."""
-        import jax
-        import jax.numpy as jnp
+    def _sharding_for(self, name):
         from jax.sharding import NamedSharding
 
-        rng = np.random.RandomState(seed)
-        params = {}
+        return NamedSharding(self.mesh, self._spec_for(name))
+
+    def _state_sharding_for(self, name, arr):
+        """Opt-state sharding: param's spec, or dp-sharded under ZeRO-1."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = self._spec_for(name)
+        if (self.zero1 and spec == P() and arr.ndim >= 1
+                and arr.shape[0] % self.mesh.shape["dp"] == 0):
+            spec = P("dp")
+        return NamedSharding(self.mesh, spec)
+
+    def batch_sharding(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self._batch_spec)
+
+    # ------------------------------------------------------------------
+    def place_params(self, arg_arrays_by_name, aux_arrays_by_name):
+        """device_put host/NDArray values onto the mesh by spec.
+
+        Accepts numpy arrays or NDArrays; returns dict of jax.Arrays."""
+        import jax
+
+        def _np(v):
+            return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+        params = {
+            n: jax.device_put(_np(arg_arrays_by_name[n]), self._sharding_for(n))
+            for n in self.param_names
+        }
+        aux = {
+            n: jax.device_put(_np(aux_arrays_by_name[n]), self._sharding_for(n))
+            for n in self.aux_names
+        }
+        return params, aux
+
+    def make_state(self, params):
+        """Build optimizer state via the optimizer's OWN create_state on
+        host zeros, then place it on the mesh (ZeRO-1 aware)."""
+        import jax
+
+        from .. import ndarray as ndmod
+
+        if self.optimizer is None:
+            return {}
+        state = {}
+        for i, name in enumerate(self.param_names):
+            p = params[name]
+            host_w = ndmod.zeros(p.shape)
+            st = self.optimizer.create_state(i, host_w)
+
+            def _place(s):
+                if s is None:
+                    return None
+                if isinstance(s, tuple):
+                    return tuple(_place(x) for x in s)
+                return jax.device_put(
+                    s.asnumpy(), self._state_sharding_for(name, s)
+                )
+
+            state[name] = _place(st)
+        return state
+
+    def init(self, arg_shapes_by_name, initializer, seed=0):
+        """Allocate + initialize sharded params/aux/opt-state on the mesh."""
+        host_params = {}
         for name in self.param_names:
             shape = arg_shapes_by_name[name]
             host = np.zeros(shape, np.float32)
@@ -88,73 +198,89 @@ class ShardedTrainStep:
                 def __setitem__(self, k, v):
                     self._a[k] = v
 
+                def asnumpy(self):
+                    return self._a
+
             wrapper = _Arr(host)
             initializer(name, wrapper)
-            sharding = NamedSharding(self.mesh, self._spec_for(name))
-            params[name] = jax.device_put(host, sharding)
-        aux = {}
-        for name, shape in arg_shapes_by_name.items():
-            if name in self.aux_names:
-                pass
+            host_params[name] = host
         _, _, aux_shapes = self.symbol.infer_shape(**arg_shapes_by_name)
+        host_aux = {}
         for name, shape in zip(self.aux_names, aux_shapes):
-            init_val = (
+            host_aux[name] = (
                 np.ones(shape, np.float32)
                 if name.endswith("var")
                 else np.zeros(shape, np.float32)
             )
-            aux[name] = jax.device_put(
-                init_val, NamedSharding(self.mesh, self._spec_for(name))
-            )
-        opt_state = self._init_opt_state(params)
+        params, aux = self.place_params(host_params, host_aux)
+        opt_state = self.make_state(params)
         return params, aux, opt_state
 
-    def _init_opt_state(self, params):
-        """SGD-momentum / Adam state, optionally dp-sharded (ZeRO-1)."""
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        if self.optimizer is None:
-            return {}
-        kind = type(self.optimizer).__name__.lower()
-        state = {}
-        for name, p in params.items():
-            spec = self._spec_for(name)
-            if self.zero1 and spec == P() and p.ndim >= 1 and p.shape[0] % self.mesh.shape["dp"] == 0:
-                spec = P("dp")  # shard replicated-param state over dp
-            sharding = NamedSharding(self.mesh, spec)
-            zeros = jax.device_put(np.zeros(p.shape, np.float32), sharding)
-            if kind in ("sgd", "nag", "ccsgd") and getattr(self.optimizer, "momentum", 0):
-                state[name] = (zeros,)
-            elif kind == "adam":
-                state[name] = (zeros, jax.device_put(
-                    np.zeros(p.shape, np.float32), sharding))
-        return state
-
     # ------------------------------------------------------------------
-    def compile(self, data_shapes_by_name):
-        """Build + jit the fused step fn. Returns self."""
+    def _apply_optimizer(self, params, grads, opt_state, lr, t):
+        """Trace through Optimizer.update for every param.
+
+        Patches the instance's step-dependent attributes with traced
+        stand-ins for the duration of the trace (this method only runs
+        at trace time), so the SAME compiled program is valid for every
+        step: lr comes from the host scheduler each call, t drives
+        Adam-style bias correction in-graph."""
+        from ..ndarray import NDArray
+
+        opt = self.optimizer
+        new_params, new_state = {}, {}
+        if opt is None:
+            for name in self.param_names:
+                new_params[name] = params[name] - lr * grads[name]
+            return new_params, new_state
+
+        saved_lr = opt.lr
+        saved_sched = opt.lr_scheduler
+        saved_counts = opt._index_update_count
+        saved_num_update = opt.num_update
+        opt.lr = lr
+        opt.lr_scheduler = None  # host computes the scheduled lr
+        opt._index_update_count = _EveryKeyCount(t)
+        opt._update_count = lambda index: None  # instance shadow
+        try:
+            for i, name in enumerate(self.param_names):
+                w = NDArray(params[name])
+                g = NDArray(grads[name])
+                st = _wrap_state(opt_state.get(name), NDArray)
+                opt.update(i, w, g, st)
+                new_params[name] = w._data
+                if st is not None:
+                    new_state[name] = _unwrap_state(st)
+            # params/state owned by a sharing module (BucketingModule:
+            # the owner dict may cover a superset of this symbol's args)
+            # pass through untouched
+            for name in params:
+                if name not in new_params:
+                    new_params[name] = params[name]
+            for name in opt_state:
+                if name not in new_state:
+                    new_state[name] = opt_state[name]
+        finally:
+            del opt.__dict__["_update_count"]
+            opt.lr = saved_lr
+            opt.lr_scheduler = saved_sched
+            opt._index_update_count = saved_counts
+            opt.num_update = saved_num_update
+        return new_params, new_state
+
+    def compile(self, data_shapes_by_name=None):
+        """Build + jit the fused step fn. Returns self.
+
+        Shardings are NOT pinned here: inputs arrive committed (placed by
+        place_params/make_state/batch device_put) and GSPMD propagates —
+        the idiomatic "computation follows sharding" path; donation keeps
+        params/opt-state in place across steps."""
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         program = self.program
-        param_names = tuple(self.param_names)
-        aux_names = tuple(self.aux_names)
-        opt = self.optimizer
-        kind = type(opt).__name__.lower() if opt is not None else None
-        lr = float(getattr(opt, "lr", 0.01)) if opt else 0.0
-        momentum = float(getattr(opt, "momentum", 0.0)) if opt else 0.0
-        wd = float(getattr(opt, "wd", 0.0)) if opt else 0.0
-        rescale = float(getattr(opt, "rescale_grad", 1.0)) if opt else 1.0
-        beta1 = float(getattr(opt, "beta1", 0.9)) if opt else 0.9
-        beta2 = float(getattr(opt, "beta2", 0.999)) if opt else 0.999
-        eps = float(getattr(opt, "epsilon", 1e-8)) if opt else 1e-8
 
-        batch_sharding = NamedSharding(self.mesh, self._batch_spec)
-
-        def step(params, aux, opt_state, batch, rng, t):
+        def step(params, aux, opt_state, batch, rng, lr, t):
             def loss_fn(ps):
                 args = dict(ps)
                 args.update(batch)
@@ -163,56 +289,36 @@ class ShardedTrainStep:
                 # convention — the loss op bakes its own gradient)
                 return sum(jnp.sum(o) for o in outs), (outs, new_aux)
 
-            grads, (outs, new_aux) = jax.grad(
-                loss_fn, has_aux=True
-            )(params)
+            grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
             # gradient allreduce over dp happens implicitly: params are
             # replicated, batch is dp-sharded → GSPMD inserts psum here.
-            new_params = {}
-            new_opt = {}
-            for name in param_names:
-                g = grads[name] * rescale + wd * params[name]
-                if kind in ("sgd", "nag", "ccsgd") and name in opt_state:
-                    (mom,) = opt_state[name]
-                    mom = momentum * mom - lr * g
-                    new_params[name] = params[name] + mom
-                    new_opt[name] = (mom,)
-                elif kind == "adam" and name in opt_state:
-                    m, v = opt_state[name]
-                    m = beta1 * m + (1 - beta1) * g
-                    v = beta2 * v + (1 - beta2) * jnp.square(g)
-                    mhat = m / (1 - beta1 ** t)
-                    vhat = v / (1 - beta2 ** t)
-                    new_params[name] = params[name] - lr * mhat / (
-                        jnp.sqrt(vhat) + eps
-                    )
-                    new_opt[name] = (m, v)
-                else:
-                    new_params[name] = params[name] - lr * g
+            new_params, new_opt = self._apply_optimizer(
+                params, grads, opt_state, lr, t
+            )
+            new_aux = {**aux, **new_aux}  # carry shared-owner extras through
             return new_params, new_aux, new_opt, outs
 
-        # pin shardings: params by spec, batch over dp
-        param_shardings = {
-            n: NamedSharding(self.mesh, self._spec_for(n))
-            for n in self.param_names
-        }
-        aux_shardings = {
-            n: NamedSharding(self.mesh, self._spec_for(n))
-            for n in self.aux_names
-        }
-        batch_shardings = {
-            n: batch_sharding for n in data_shapes_by_name
-        }
-        self._step = jax.jit(
-            step,
-            in_shardings=(
-                param_shardings, aux_shardings, None, batch_shardings,
-                None, None,
-            ),
-            donate_argnums=(0, 2),
-        )
+        self._step = jax.jit(step, donate_argnums=(0, 1, 2))
         return self
 
-    def __call__(self, params, aux, opt_state, batch, rng, t=1):
+    def __call__(self, params, aux, opt_state, batch, rng=None, lr=None, t=1):
         assert self._step is not None, "call compile() first"
-        return self._step(params, aux, opt_state, batch, rng, t)
+        import jax.numpy as jnp
+
+        if lr is None:
+            opt = self.optimizer
+            if opt is not None and opt.lr_scheduler is not None:
+                lr = float(opt.lr_scheduler(opt.num_update))
+            else:
+                lr = float(getattr(opt, "lr", 0.01))
+        if rng is None:
+            if self._needs_rng:
+                from .. import random as _random
+
+                rng = _random.next_key()
+            else:
+                rng = jnp.zeros((2,), jnp.uint32)  # unused placeholder
+        return self._step(
+            params, aux, opt_state, batch, rng,
+            jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.float32),
+        )

@@ -44,16 +44,20 @@ class Context:
 
     @property
     def jax_device(self):
-        """Resolve to a concrete jax.Device (lazily, so CPU-only envs work)."""
+        """Resolve to a concrete PROCESS-LOCAL jax.Device (lazily, so
+        CPU-only envs work). Local, not global: the reference's cpu(i)/
+        gpu(i) numbers devices on this host, and in a multi-controller
+        job a global index would hand rank 1 a peer's non-addressable
+        device."""
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
         else:  # 'gpu' is an accelerator alias: prefer tpu, fall back to gpu
             devs = None
             for plat in ("tpu", "gpu"):
                 try:
-                    devs = jax.devices(plat)
+                    devs = jax.local_devices(backend=plat)
                     break
                 except RuntimeError:
                     continue
@@ -62,7 +66,7 @@ class Context:
                 # to CPU devices so multi-"device" tests run anywhere, the
                 # same trick the reference plays with mx.cpu(1)/mx.cpu(2) in
                 # tests/python/unittest/test_multi_device_exec.py.
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
         if self.device_id >= len(devs):
             raise MXNetError(
                 "context %s: device_id %d out of range (%d %s devices visible)"
@@ -121,9 +125,10 @@ def current_context():
 
 
 def num_devices(device_type="tpu"):
+    """Count of THIS process's devices (reference num_gpus is per-host)."""
     import jax
 
     try:
-        return len(jax.devices(device_type))
+        return len(jax.local_devices(backend=device_type))
     except RuntimeError:
         return 0

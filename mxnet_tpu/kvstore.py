@@ -49,19 +49,49 @@ class KVStore(object):
         self._store = {}
         self._updater = None
         self._barrier_count = 0
-        # Multi-process distributed rank/size come from the JAX bootstrap
-        # (jax.distributed) or the reference's DMLC_* env names.
-        self._rank = int(os.environ.get("DMLC_RANK", os.environ.get("JAX_PROCESS_ID", 0)))
-        self._size = int(
-            os.environ.get("DMLC_NUM_WORKER", os.environ.get("JAX_NUM_PROCESSES", 1))
-        )
+        # Multi-process distributed rank/size come from the JAX runtime
+        # itself once a dist store is requested (the env names are only
+        # the pre-init fallback): trusting env alone let round-2 report
+        # a size the runtime never actually had.
+        self._rank = int(os.environ.get(
+            "DMLC_RANK", os.environ.get("JAX_PROCESS_ID", 0)))
+        self._size = int(os.environ.get(
+            "DMLC_NUM_WORKER", os.environ.get("JAX_NUM_PROCESSES", 1)))
+        if "dist" in kv_type:
+            import jax
+
+            from .parallel import init_distributed
+
+            # The reference joins the PS cluster at kvstore creation
+            # (KVStore::InitPSEnv); the analog is joining the JAX
+            # distributed runtime here, so scripts that only ever call
+            # mx.kv.create('dist_sync') work unmodified under launch.py.
+            init_distributed()
+            env_size = self._size
+            self._rank = jax.process_index()
+            self._size = jax.process_count()
+            if env_size > 1 and self._size == 1:
+                raise MXNetError(
+                    "kvstore %s: launcher env promises %d workers but this "
+                    "process never joined a distributed JAX runtime "
+                    "(missing/unreachable coordinator?) — refusing to "
+                    "silently train un-synchronized" % (kv_type, env_size))
 
     # ------------------------------------------------------------------
     def init(self, key, value):
         for k, vals in _ctype_key_value(key, value):
             if k in self._store:
                 raise MXNetError("key %s already initialized" % str(k))
-            self._store[k] = vals[0].copy()
+            v = vals[0]
+            if self._is_dist:
+                # Reference dist init: rank 0's value lands on the
+                # servers and every worker pulls it — all workers start
+                # identical whatever their local seeding did.
+                from .parallel import mesh as _mesh
+
+                v = nd.array(_mesh.broadcast_from_root(v.asnumpy()),
+                             ctx=v.context, dtype=v.dtype)
+            self._store[k] = v.copy()
 
     def push(self, key, value, priority=0):
         """Reduce value(s) into the store; updater applies if set.
@@ -72,6 +102,17 @@ class KVStore(object):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
             merged = self._reduce(vals)
+            if self._is_dist:
+                # Cross-worker merge (the server-side merge_buf_ sum in
+                # kvstore_dist_server.h:163-200, minus the server): every
+                # worker contributes, every worker sees the global sum.
+                # dist_async gets the same synchronous reduction — with
+                # no PS tier there is no one-sided push target, and sync
+                # semantics are strictly stronger.
+                from .parallel import mesh as _mesh
+
+                merged = nd.array(_mesh.allreduce_sum(merged.asnumpy()),
+                                  ctx=merged.context, dtype=merged.dtype)
             if self._updater is not None:
                 self._updater(
                     k if isinstance(k, int) else self._str_key(k), merged,
@@ -137,20 +178,21 @@ class KVStore(object):
     def num_workers(self):
         return self._size
 
+    @property
+    def _is_dist(self):
+        return "dist" in self.type and self._size > 1
+
     def _barrier(self):
-        """Global barrier (reference: ps::Postoffice::Barrier). Multi-host
-        jax programs synchronize implicitly at collective boundaries; an
-        explicit barrier only matters cross-process."""
+        """Global barrier (reference: ps::Postoffice::Barrier).
+
+        Must hard-fail if a peer is unreachable — a barrier that
+        swallows errors silently un-synchronizes exactly the path that
+        exists to synchronize (round-1/2 finding, fixed)."""
         if self._size > 1:
-            import jax
+            from .parallel import barrier as _mesh_barrier
 
-            # a tiny psum across processes acts as the barrier
-            try:
-                from .parallel import barrier as _mesh_barrier
-
-                _mesh_barrier()
-            except Exception:
-                pass
+            self._barrier_count += 1
+            _mesh_barrier("kvstore-barrier-%d" % self._barrier_count)
 
     def save_optimizer_states(self, fname):
         if self._updater is None:

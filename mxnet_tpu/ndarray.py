@@ -44,12 +44,23 @@ def _jax():
 
 
 def _ctx_of_jax_device(dev):
+    """Context for a jax.Device — by LOCAL index, not global id.
+
+    Context.jax_device indexes jax.local_devices(), so the round-trip
+    must too: in a multi-controller job rank 1's first device has a
+    global id >= num_local, and Context('cpu', global_id) would be out
+    of range (or, worse, some peer's device)."""
     plat = dev.platform
+    jax = _jax()
+    try:
+        idx = jax.local_devices(backend=plat).index(dev)
+    except (RuntimeError, ValueError):
+        idx = dev.id  # non-addressable peer device: keep the global id
     if plat == "cpu":
-        return Context("cpu", dev.id)
+        return Context("cpu", idx)
     if plat in ("tpu", "axon"):
-        return Context("tpu", dev.id)
-    return Context("gpu", dev.id)
+        return Context("tpu", idx)
+    return Context("gpu", idx)
 
 
 def _hashable(v):

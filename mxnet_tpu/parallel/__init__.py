@@ -11,7 +11,7 @@ PlaceDevice pass) becomes PartitionSpec annotations.
 """
 from .mesh import (
     make_mesh, barrier, dp_sharding, replicated_sharding, device_count,
-    init_distributed,
+    init_distributed, allreduce_sum, broadcast_from_root,
 )
 from .train_step import ShardedTrainStep
 from .ring_attention import ring_attention
@@ -19,4 +19,5 @@ from .ring_attention import ring_attention
 __all__ = [
     "make_mesh", "barrier", "dp_sharding", "replicated_sharding",
     "device_count", "ShardedTrainStep", "ring_attention",
+    "init_distributed", "allreduce_sum", "broadcast_from_root",
 ]

@@ -57,14 +57,79 @@ def replicated_sharding(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
-def barrier(mesh=None):
-    """Cross-device barrier: a tiny psum everyone must reach (the TPU
-    stand-in for ps::Postoffice::Barrier)."""
-    import jax
-    import jax.numpy as jnp
+def barrier(tag="mxnet-tpu-barrier"):
+    """Cross-PROCESS barrier (the TPU stand-in for ps::Postoffice::Barrier).
 
-    x = jnp.ones(())
-    jax.block_until_ready(x + 0)
+    Every process in the distributed runtime must reach this call before
+    any returns — enforced by the coordination service via
+    ``sync_global_devices``, which hard-fails (rather than silently
+    passing) if a peer is gone. Single-process jobs return immediately:
+    within one process XLA's program order already serializes."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+_ALLREDUCE_CACHE = {}
+
+
+def allreduce_sum(value):
+    """Sum a host value across ALL processes; returns numpy on each.
+
+    The explicit (non-compiled) cross-worker reduction behind KVStore
+    dist push — the TPU-native replacement for the reference's
+    ps::KVWorker::ZPush + server-side merge (kvstore_dist_server.h
+    DataHandleEx sync path). The compiled training path never calls
+    this: there gradients sync as in-step psum over ICI/DCN.
+
+    Implemented as a real XLA reduction over a device axis spanning all
+    processes — O(N) on the wire and in host memory, unlike an
+    allgather-then-sum which is O(P*N) per push and would dominate at
+    real model sizes. Each process stages its contribution on its first
+    local device (other local devices contribute zeros), XLA sums over
+    the axis, and the replicated result is read back locally."""
+    import jax
+
+    value = np.asarray(value)
+    if jax.process_count() <= 1:
+        return value
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    nloc = jax.local_device_count()
+    key = (value.shape, value.dtype.str, nloc)
+    if key not in _ALLREDUCE_CACHE:
+        mesh = Mesh(np.asarray(jax.devices()), ("proc",))
+        in_sharding = NamedSharding(mesh, P("proc"))
+        out_sharding = NamedSharding(mesh, P())
+        fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                     out_shardings=out_sharding)
+        _ALLREDUCE_CACHE[key] = (in_sharding, fn)
+    in_sharding, fn = _ALLREDUCE_CACHE[key]
+    # exact sum: the value rides row 0, the other local rows are zeros
+    local = np.zeros((nloc,) + value.shape, value.dtype)
+    local[0] = value
+    garr = jax.make_array_from_process_local_data(in_sharding, local)
+    return np.asarray(fn(garr).addressable_data(0))
+
+
+def broadcast_from_root(value):
+    """Broadcast a host value from process 0 to every process.
+
+    KVStore dist init semantics: the reference's kv.init writes rank 0's
+    value to the servers and every worker pulls it, so all workers start
+    from identical weights regardless of local seeding."""
+    import jax
+
+    value = np.asarray(value)
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(value))
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
@@ -89,6 +154,15 @@ def init_distributed(coordinator_address=None, num_processes=None,
                      else os.environ.get("JAX_PROCESS_ID", 0))
     if num_processes <= 1 or coordinator_address is None:
         return False
+    if jax.distributed.is_initialized():
+        return True
+    try:
+        # The CPU backend needs an explicit collectives implementation
+        # for cross-process psum/allgather (without it they silently
+        # reduce over local devices only — tested, not hypothetical).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass  # older jax: option absent, CPU multi-process unsupported
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -1,0 +1,168 @@
+"""Worker body for the 2-process distributed tests (test_dist.py).
+
+Launched N times by tools/launch.py local mode; each process joins the
+JAX distributed runtime over the coordinator env the launcher set, then
+proves the three things a distributed MXNet worker needs (reference
+proof: tests/nightly/dist_sync_kvstore.py + dist_lenet.py):
+
+1. dist_sync KVStore push/pull crosses the process boundary with the
+   reference's deterministic cross-worker sum.
+2. barrier() actually synchronizes processes (measured skew, not
+   vibes: rank 0 must WAIT for the sleeping peer).
+3. the fused ShardedTrainStep runs over a mesh SPANNING processes:
+   gradients psum over dp across the process boundary inside the
+   compiled step, loss falls, and ranks stay bit-identical.
+
+Writes rank{r}.json into --out; any assertion kills the worker and the
+launcher's exit code fails the pytest.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+# Platform routing must happen before ANY jax backend touch: 2 local CPU
+# devices per process so the global mesh (4 devices / 2 processes) has
+# both intra- and inter-process axes.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel import init_distributed  # noqa: E402
+
+init_distributed()
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.parallel import ShardedTrainStep, barrier, make_mesh  # noqa: E402
+from mxnet_tpu.parallel.mesh import allreduce_sum  # noqa: E402
+
+
+def check_kvstore(rank, size, results):
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == rank, (kv.rank, rank)
+    assert kv.num_workers == size, (kv.num_workers, size)
+    shape = (5, 7)
+    # init must broadcast rank 0's value: give ranks DIFFERENT values
+    kv.init(3, mx.nd.ones(shape) * (42 if rank == 0 else -1))
+    pulled = mx.nd.zeros(shape)
+    kv.pull(3, out=pulled)
+    np.testing.assert_allclose(pulled.asnumpy(), 42.0)
+
+    # reference dist_sync_kvstore.py semantics: every push merges across
+    # workers; with updater store += rate * merged the stored value after
+    # nrepeat pushes of (rank+1)-filled arrays is
+    #   init + rate * nrepeat * sum_r(r+1)
+    rate = 2.0
+    kv.set_updater(lambda key, recv, stored: stored.__iadd__(recv * rate))
+    nrepeat = 3
+    for _ in range(nrepeat):
+        # two "device" shards per worker, like pushing a per-device list:
+        # local reduce then cross-worker merge
+        kv.push(3, [mx.nd.ones(shape) * (rank + 1) * 0.5,
+                    mx.nd.ones(shape) * (rank + 1) * 0.5])
+    kv.pull(3, out=pulled)
+    expected = 42.0 + rate * nrepeat * sum(r + 1 for r in range(size))
+    np.testing.assert_allclose(pulled.asnumpy(), expected, rtol=1e-6)
+    results["kvstore_value"] = float(pulled.asnumpy()[0, 0])
+    results["kvstore_expected"] = expected
+
+
+def check_barrier_skew(rank, results):
+    """rank != 0 sleeps before the barrier; rank 0's measured wait proves
+    the barrier blocked on the peer rather than passing locally."""
+    sleep_s = 2.0
+    t0 = time.perf_counter()
+    if rank != 0:
+        time.sleep(sleep_s)
+    barrier("skew-test")
+    waited = time.perf_counter() - t0
+    if rank == 0:
+        assert waited >= 0.5 * sleep_s, (
+            "barrier returned in %.2fs while peer slept %.1fs: not a real "
+            "barrier" % (waited, sleep_s))
+    results["barrier_wait_s"] = round(waited, 3)
+
+
+def check_fused_step(rank, size, results):
+    ndev = jax.device_count()
+    mesh = make_mesh(dp=ndev)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    opt = mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0 / 16)
+    step = ShardedTrainStep(net, mesh, optimizer=opt).compile()
+    shapes = {"data": (16, 8), "softmax_label": (16,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    shapes_by_name = dict(zip(net.list_arguments(), arg_shapes))
+    np.random.seed(7)
+    params, aux, opt_state = step.init(shapes_by_name, mx.initializer.Xavier())
+
+    rng = np.random.RandomState(0)  # same data on every rank, split below
+    X = rng.randn(16, 8).astype(np.float32)
+    y = (rng.rand(16) * 4).astype(np.float32)
+    # each process feeds ONLY its local rows of the globally-sharded batch
+    per = 16 // size
+    lo = rank * per
+    sharding = step.batch_sharding()
+    batch = {
+        "data": jax.make_array_from_process_local_data(
+            sharding, X[lo:lo + per]),
+        "softmax_label": jax.make_array_from_process_local_data(
+            sharding, y[lo:lo + per]),
+    }
+
+    def loss_of(outs):
+        # outs[0] is dp-sharded softmax probs; score the local rows only
+        local = np.concatenate(
+            [np.asarray(s.data) for s in outs[0].addressable_shards])
+        lab = y[lo:lo + per].astype(int)
+        return float(-np.mean(np.log(local[np.arange(per), lab] + 1e-8)))
+
+    losses = []
+    for t in range(12):
+        params, aux, opt_state, outs = step(
+            params, aux, opt_state, batch, t=t + 1)
+        losses.append(loss_of(outs))
+    assert losses[-1] < 0.5 * losses[0], losses
+    results["fused_losses"] = [round(l, 4) for l in (losses[0], losses[-1])]
+
+    # ranks must agree bit-for-bit on the replicated params
+    w = np.asarray(jax.device_get(
+        params["fc1_weight"].addressable_shards[0].data))
+    gathered = allreduce_sum(w)  # sum of identical copies = size * w
+    np.testing.assert_array_equal(gathered, w * size)
+    results["params_identical"] = True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    rank = jax.process_index()
+    size = jax.process_count()
+    assert size > 1, "worker did not join a multi-process runtime"
+
+    results = {"rank": rank, "size": size,
+               "global_devices": jax.device_count()}
+    check_kvstore(rank, size, results)
+    check_barrier_skew(rank, results)
+    check_fused_step(rank, size, results)
+    results["ok"] = True
+    with open(os.path.join(args.out, "rank%d.json" % rank), "w") as f:
+        json.dump(results, f)
+    print("[dist_worker rank %d] ok" % rank, flush=True)
+
+
+if __name__ == "__main__":
+    main()

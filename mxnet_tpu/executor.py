@@ -135,6 +135,29 @@ class _LazyOutputs:
         return repr(self._exe.outputs)
 
 
+def resolve_creation_shapes(symbol, shapes_by_name):
+    """For creation ops (_zeros/_ones) whose shape attr has unknown (0)
+    dims — MXNet's bind-time-inferred convention, e.g. rnn_cell
+    begin_state batch dims — resolve concrete shapes via graph-wide
+    inference given the input shapes. Returns a _GraphProgram
+    shape_overrides dict. Used by Executor at bind and ShardedTrainStep
+    at first call (same program layer, two front doors)."""
+    nodes = _topo_order([n for n, _ in symbol._outputs])
+    from .ops.utils import as_tuple
+
+    def _shape_attr(n):
+        return as_tuple(n.canon_attrs().get("shape")) or ()
+
+    pending = [
+        n for n in nodes
+        if (not n.is_variable) and not n.inputs and 0 in _shape_attr(n)
+    ]
+    if not pending:
+        return {}
+    env = symbol._infer_shape_env(**shapes_by_name)
+    return {id(n): env[(id(n), 0)] for n in pending if (id(n), 0) in env}
+
+
 class Executor:
     """Bound computation: holds arg/grad/aux NDArrays + compiled step fns.
 
@@ -179,26 +202,11 @@ class Executor:
 
     @staticmethod
     def _resolve_creation_shapes(symbol, arg_arrays):
-        """For creation ops (_zeros/_ones) with unknown dims in their shape
-        attr, resolve concrete shapes via graph-wide inference."""
-        nodes = _topo_order([n for n, _ in symbol._outputs])
-        from .ops.utils import as_tuple
-
-        def _shape_attr(n):
-            return as_tuple(n.canon_attrs().get("shape")) or ()
-
-        pending = [
-            n for n in nodes
-            if (not n.is_variable) and not n.inputs and 0 in _shape_attr(n)
-        ]
-        if not pending:
-            return {}
         arg_names = symbol.list_arguments()
         shapes = {
             n: a.shape for n, a in zip(arg_names, arg_arrays) if a is not None
         }
-        env = symbol._infer_shape_env(**shapes)
-        return {id(n): env[(id(n), 0)] for n in pending if (id(n), 0) in env}
+        return resolve_creation_shapes(symbol, shapes)
 
     # ------------------------------------------------------------------
     # compiled callables

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import logging
 
+import numpy as np
+
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
@@ -25,13 +27,26 @@ from .executor_group import DataParallelExecutorGroup
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
-                 context=None, work_load_list=None, fixed_param_names=None):
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 mesh=None, param_specs=None):
+        """``mesh``/``param_specs`` extend the reference surface for the
+        fused path: pass a multi-axis jax Mesh (dp x tp x ...) and
+        per-param PartitionSpecs and the whole train step compiles over
+        it — tensor parallelism through the same Module.fit the
+        reference drives with ctx lists (SURVEY §2.3: TP is the
+        "for free via GSPMD" row)."""
         super().__init__(logger=logger)
         if context is None:
             context = [ctx_mod.current_context()]
         if isinstance(context, ctx_mod.Context):
             context = [context]
         self._context = context
+        if mesh is not None and "dp" not in mesh.axis_names:
+            raise MXNetError(
+                "Module mesh must have a 'dp' axis (the batch dimension "
+                "shards over it); got axes %s" % (mesh.axis_names,))
+        self._mesh = mesh
+        self._param_specs = param_specs
         if work_load_list is None:
             work_load_list = [1] * len(self._context)
         assert len(work_load_list) == len(self._context)
@@ -247,8 +262,13 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+        # an explicit mesh IS the device set: its size (not the ctx list,
+        # which only hosts the eval executors) decides whether a kvstore
+        # is needed at all (reference model.py:40 drops it for 1 device)
+        num_device = (self._mesh.size if self._mesh is not None
+                      else len(self._context))
         (kvstore, update_on_kvstore) = _create_kvstore(
-            kvstore, len(self._context), self._arg_params
+            kvstore, num_device, self._arg_params
         )
         batch_size = self._exec_group.batch_size
         if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
@@ -294,6 +314,15 @@ class Module(BaseModule):
             self._updater = opt.get_updater(optimizer)
         if self._fusable(kvstore):
             self._init_fused()
+        elif self._mesh is not None:
+            # the user explicitly asked for a mesh; quietly training
+            # single-device instead would be a silent wrong answer
+            raise MXNetError(
+                "Module was given a mesh but training cannot take the "
+                "fused path: requires kvstore 'device'/'dist_device_sync' "
+                "(got %r), for_training, no inputs_need_grad, no "
+                "fixed_param_names, and batch_size %% dp == 0"
+                % (getattr(kvstore, "type", kvstore),))
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
@@ -305,25 +334,30 @@ class Module(BaseModule):
         fused ShardedTrainStep (SURVEY §5.8: device-side reduce ≡ in-XLA
         allreduce over the mesh). The executor-group path remains for
         inference, input grads, and the 'local' kvstore."""
+        dp = (self._mesh.shape.get("dp", 1) if self._mesh is not None
+              else len(self._context))
         return (
             kvstore is not None
             and "device" in kvstore.type
             and self.for_training
             and not self.inputs_need_grad
             and not self._fixed_param_names
-            and self._exec_group.batch_size % len(self._context) == 0
+            and self._exec_group.batch_size % dp == 0
         )
 
     def _init_fused(self):
-        import numpy as np
         from jax.sharding import Mesh
 
         from ..parallel.train_step import ShardedTrainStep
 
-        devices = [c.jax_device for c in self._context]
-        mesh = Mesh(np.asarray(devices), ("dp",))
+        if self._mesh is not None:
+            mesh = self._mesh
+        else:
+            devices = [c.jax_device for c in self._context]
+            mesh = Mesh(np.asarray(devices), ("dp",))
         self._fused_trainer = ShardedTrainStep(
             self._symbol, mesh, optimizer=self._optimizer,
+            param_specs=self._param_specs,
             data_names=self._data_names, label_names=self._label_names,
         ).compile()
         self._fused_owner = self
@@ -336,7 +370,6 @@ class Module(BaseModule):
 
     def _make_fused_batch(self, data_batch):
         import jax
-        import numpy as np
 
         sharding = self._fused_trainer.batch_sharding()
         batch = {}
@@ -374,6 +407,7 @@ class Module(BaseModule):
             self._fused_trainer = ShardedTrainStep(
                 self._symbol, shared_module._fused_trainer.mesh,
                 optimizer=self._optimizer,
+                param_specs=shared_module._fused_trainer.param_specs,
                 data_names=self._data_names, label_names=self._label_names,
             ).compile()
         self.optimizer_initialized = True
@@ -476,7 +510,6 @@ class Module(BaseModule):
     def _sync_params_from_devices(self):
         """Parity module.py:666."""
         if self._fused_trainer is not None:
-            import numpy as np
 
             owner = self._fused_owner
             for name, arr in owner._fused_params.items():
@@ -496,7 +529,6 @@ class Module(BaseModule):
         if self._fused_trainer is not None:
             import pickle
 
-            import numpy as np
 
             owner = self._fused_owner
 

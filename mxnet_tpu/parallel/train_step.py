@@ -103,6 +103,7 @@ class ShardedTrainStep:
         self.param_specs = dict(param_specs or {})
         self._batch_spec = P("dp")
         self._step = None
+        self._creation_shapes_sig = None
         self._needs_rng = any(
             (not n.is_variable) and n.op.needs_rng
             for n in self.program.nodes
@@ -304,6 +305,21 @@ class ShardedTrainStep:
     def __call__(self, params, aux, opt_state, batch, rng=None, lr=None, t=1):
         assert self._step is not None, "call compile() first"
         import jax.numpy as jnp
+
+        # resolve 0-dims in creation-op shape attrs (rnn begin_state zeros
+        # etc.) against the CURRENT input shapes, before jit traces: keyed
+        # by shape signature so a batch-size change (Module.reshape,
+        # partial final batch) re-resolves instead of retracing against
+        # stale overrides. Already-traced signatures stay cached in jit.
+        shapes = {n: tuple(v.shape) for n, v in params.items()}
+        shapes.update({n: tuple(v.shape) for n, v in batch.items()})
+        sig = tuple(sorted(shapes.items()))
+        if sig != self._creation_shapes_sig:
+            from ..executor import resolve_creation_shapes
+
+            self.program.shape_overrides = resolve_creation_shapes(
+                self.symbol, shapes)
+            self._creation_shapes_sig = sig
 
         if lr is None:
             opt = self.optimizer

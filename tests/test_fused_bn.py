@@ -1,0 +1,108 @@
+"""BatchNorm semantics across the two training paths (SURVEY §7 hard part).
+
+Pins, falsifiably, what each path computes when the batch is split
+across devices:
+
+- FUSED mesh path (kvstore='device', ShardedTrainStep): the dp-sharded
+  batch is ONE logical tensor, so GSPMD reduces BN statistics over the
+  GLOBAL batch — bit-matching the single-device run. (Reference
+  single-device semantics; its accuracy goldens were all trained this
+  way on one device per worker, src/operator/batch_norm-inl.h.)
+- EXECUTOR path (kvstore='local'/None, per-device executors): each
+  device normalizes with ITS OWN slice's statistics, the reference's
+  multi-device behavior (no sync-BN in 0.9.5); get_params then averages
+  the per-device moving stats.
+
+The data is constructed so the two disagree loudly: per-slice means are
+far apart, so global variance (~between-slice spread) dwarfs the
+per-slice variances, and moving_var separates the paths by >10x.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+B, C = 8, 2
+MOM = 0.9  # BatchNorm default momentum
+
+
+def _bn_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data, name="bn", momentum=MOM, fix_gamma=True)
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(net, name="lro")
+
+
+def _make_data(n_groups):
+    """B rows in n_groups contiguous blocks with very different means."""
+    rng = np.random.RandomState(0)
+    offsets = np.linspace(-30, 30, n_groups)
+    X = np.concatenate([
+        off + rng.randn(B // n_groups, C, 1, 1)
+        for off in offsets
+    ]).astype(np.float32)
+    y = rng.randn(B, 1).astype(np.float32)
+    return X, y
+
+
+def _train_one_batch(contexts, kvstore, X, y):
+    it = mx.io.NDArrayIter(X, y, batch_size=B,
+                           label_name="lro_label")
+    mod = mx.mod.Module(_bn_net(), label_names=("lro_label",),
+                        context=contexts)
+    mod.bind(it.provide_data, it.provide_label)
+    np.random.seed(1)
+    mx.random.seed(1)
+    mod.init_params(mx.initializer.Uniform(0.01))
+    mod.init_optimizer(
+        kvstore=kvstore, optimizer="sgd",
+        optimizer_params={"learning_rate": 1e-6})
+    batch = next(iter(it))
+    mod.forward(batch)
+    mod.backward()
+    mod.update()
+    _, aux = mod.get_params()
+    if kvstore == "device":
+        assert mod._fused_trainer is not None
+    else:
+        assert mod._fused_trainer is None
+    return {k: v.asnumpy() for k, v in aux.items()}
+
+
+def test_fused_bn_uses_global_batch_stats():
+    """Fused dp=4: moving_var reflects the GLOBAL batch variance and
+    matches the single-device run exactly."""
+    X, y = _make_data(n_groups=4)
+    aux_fused = _train_one_batch([mx.cpu(i) for i in range(4)], "device",
+                                 X, y)
+    aux_single = _train_one_batch([mx.cpu(0)], None, X, y)
+
+    global_var = X.var(axis=(0, 2, 3))
+    expect_var = MOM * 1.0 + (1 - MOM) * global_var
+    np.testing.assert_allclose(aux_fused["bn_moving_var"], expect_var,
+                               rtol=1e-4)
+    np.testing.assert_allclose(aux_fused["bn_moving_var"],
+                               aux_single["bn_moving_var"], rtol=1e-5)
+    np.testing.assert_allclose(aux_fused["bn_moving_mean"],
+                               aux_single["bn_moving_mean"],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_executor_path_uses_per_device_stats():
+    """Executor path over 2 contexts: each device normalizes with its own
+    slice's stats; the merged moving_var is the AVERAGE of per-slice
+    variances — an order of magnitude below the global variance."""
+    X, y = _make_data(n_groups=2)
+    aux = _train_one_batch([mx.cpu(0), mx.cpu(1)], "local", X, y)
+
+    half = B // 2
+    per_dev_var = np.stack([
+        X[:half].var(axis=(0, 2, 3)), X[half:].var(axis=(0, 2, 3))
+    ]).mean(axis=0)
+    expect_var = MOM * 1.0 + (1 - MOM) * per_dev_var
+    np.testing.assert_allclose(aux["bn_moving_var"], expect_var, rtol=1e-4)
+
+    # and it is NOT the global-batch answer: the paths genuinely differ
+    global_expect = MOM * 1.0 + (1 - MOM) * X.var(axis=(0, 2, 3))
+    assert np.all(global_expect > 10 * aux["bn_moving_var"])

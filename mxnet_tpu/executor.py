@@ -41,6 +41,28 @@ def _as_jax(x):
     return x._data if isinstance(x, NDArray) else x
 
 
+def _node_attrs(program, node, rng):
+    """Execution-time attrs for one node — the ONE place where per-node
+    execution semantics (shape overrides, CustomOp scoping keys, rng
+    folding) live; _GraphProgram.__call__ and _PlacedProgram segments
+    both call it so the two paths cannot silently diverge."""
+    attrs = node.canon_attrs()
+    if id(node) in program.shape_overrides:
+        attrs["shape"] = program.shape_overrides[id(node)]
+    if node.op.name == "Custom":
+        # stateful CustomOp instances live per (bind, node) like the
+        # reference's one-CustomOp-per-bind (custom-inl.h); the host
+        # uses these keys to scope instance caching
+        attrs["__program_id__"] = program._program_uid
+        attrs["__node_name__"] = node.name
+    if node.op.needs_rng:
+        if rng is None:
+            raise MXNetError("executor: rng required for %s" % node.name)
+        attrs["__rng__"] = jax.random.fold_in(
+            rng, program._node_ids[id(node)])
+    return attrs
+
+
 class _GraphProgram:
     """A symbol lowered to a pure function of (args, aux, rng) — the unit
     that gets jitted. Built once per bind; shared by fwd and fwd+bwd."""
@@ -85,19 +107,7 @@ class _GraphProgram:
                 if (id(node), 0) not in env:
                     raise MXNetError("executor: missing input %s" % node.name)
                 continue
-            attrs = node.canon_attrs()
-            if id(node) in self.shape_overrides:
-                attrs["shape"] = self.shape_overrides[id(node)]
-            if node.op.name == "Custom":
-                # stateful CustomOp instances live per (bind, node) like the
-                # reference's one-CustomOp-per-bind (custom-inl.h); the host
-                # uses these keys to scope instance caching
-                attrs["__program_id__"] = self._program_uid
-                attrs["__node_name__"] = node.name
-            if node.op.needs_rng:
-                if rng is None:
-                    raise MXNetError("executor: rng required for %s" % node.name)
-                attrs["__rng__"] = jax.random.fold_in(rng, self._node_ids[id(node)])
+            attrs = _node_attrs(self, node, rng)
             in_vals = [env[(id(c), i)] for (c, i) in node.inputs]
             results = node.op.fcompute(attrs, in_vals, is_train)
             n_outs = node.num_outputs()
@@ -133,6 +143,211 @@ class _LazyOutputs:
 
     def __repr__(self):
         return repr(self._exe.outputs)
+
+
+class _PlacedProgram:
+    """Model-parallel execution of a _GraphProgram across devices.
+
+    The TPU-native redesign of the reference's placement pipeline
+    (nnvm::pass::PlaceDevice + _CrossDeviceCopy insertion + engine
+    overlap, src/executor/graph_executor.cc:245-334,
+    src/operator/cross_device_copy.cc): the topo order is split into
+    maximal contiguous same-device segments; each segment jit-compiles
+    ONCE on its device (computation follows its committed inputs);
+    boundary values move with an explicit eager ``jax.device_put`` — the
+    _CrossDeviceCopy analog — and jax's async dispatch pipelines
+    segments on different devices exactly like the reference's engine
+    pipelines model-parallel LSTM stages.
+
+    Backward runs segment-by-segment in reverse: each segment has a
+    cached JITTED backward that recomputes its forward from the saved
+    boundary inputs and transposes it (rematerialization — one extra
+    segment-forward per step buys a fully-compiled backward with no
+    per-step python AD tracing). Cotangents move back across the same
+    device boundaries, and are only computed for inputs that can reach
+    a gradient variable — data/label cotangents are never materialized.
+    This stitched design exists because SPMD alone cannot express
+    distinct per-stage computations on distinct devices in one program.
+    """
+
+    def __init__(self, program, node_dev, grad_names=()):
+        self.program = program
+        segs = []
+        for node in program.nodes:
+            if node.is_variable:
+                continue
+            dev = node_dev[id(node)]
+            if segs and segs[-1][0] == dev:
+                segs[-1][1].append(node)
+            else:
+                segs.append((dev, [node]))
+        self.segments = segs
+
+        # which nodes' outputs can influence a gradient variable's ct:
+        # a value needs a cotangent iff a grad var is among its ancestors
+        grad_names = set(grad_names)
+        needs_ct = {}
+        for node in program.nodes:
+            if node.is_variable:
+                needs_ct[id(node)] = node.name in grad_names
+            else:
+                needs_ct[id(node)] = any(
+                    needs_ct[id(c)] for (c, _) in node.inputs)
+        self._needs_ct = needs_ct
+
+        final_keys = {(id(n), i) for n, i in program.output_entries}
+        raw = []
+        for dev, nodes in segs:
+            in_seg = {id(n) for n in nodes}
+            needs, seen = [], set()
+            prods = []
+            aux_names = []
+            for node in nodes:
+                for (c, i) in node.inputs:
+                    k = (id(c), i)
+                    if id(c) in in_seg or k in seen:
+                        continue
+                    seen.add(k)
+                    needs.append(k)
+                prods.extend(
+                    (id(node), i) for i in range(node.num_outputs()))
+                n_args = node._extra.get("n_args", len(node.inputs))
+                aux_names.extend(c.name for (c, _) in node.inputs[n_args:])
+            raw.append((needs, prods, aux_names))
+        # keep only produced keys someone later actually reads
+        consumed = set(final_keys)
+        for needs, _, _ in raw:
+            consumed.update(needs)
+        self._seg_io = [
+            (needs, [k for k in prods if k in consumed], aux_names)
+            for needs, prods, aux_names in raw
+        ]
+        self._fn_cache = {}
+
+    def _seg_run(self, si, is_train):
+        """Pure per-segment forward body (traced under fwd and bwd jits)."""
+        _, nodes = self.segments[si]
+        needs, out_keys, _ = self._seg_io[si]
+        program = self.program
+
+        def run(in_vals, rng):
+            env = dict(zip(needs, in_vals))
+            aux_out = []
+            for node in nodes:
+                attrs = _node_attrs(program, node, rng)
+                ins = [env[(id(c), i)] for (c, i) in node.inputs]
+                results = node.op.fcompute(attrs, ins, is_train)
+                n_outs = node.num_outputs()
+                for i, v in enumerate(results[:n_outs]):
+                    env[(id(node), i)] = v
+                n_args = node._extra.get("n_args", len(node.inputs))
+                for _c, v in zip(node.inputs[n_args:], results[n_outs:]):
+                    aux_out.append(v)
+            return tuple(env[k] for k in out_keys), tuple(aux_out)
+
+        return run
+
+    def _seg_fn(self, si, is_train):
+        key = ("fwd", si, is_train)
+        if key not in self._fn_cache:
+            self._fn_cache[key] = jax.jit(self._seg_run(si, is_train))
+        return self._fn_cache[key]
+
+    def _seg_bwd_fn(self, si):
+        """Jitted backward for segment si: recompute forward from the
+        saved boundary inputs, transpose, and return cotangents ONLY for
+        inputs that can reach a gradient variable."""
+        key = ("bwd", si)
+        if key not in self._fn_cache:
+            needs, _, _ = self._seg_io[si]
+            diff_idx = tuple(
+                i for i, (nid, _o) in enumerate(needs)
+                if self._needs_ct.get(nid, False))
+            run = self._seg_run(si, True)
+
+            def bwd(in_vals, rng, cts_out, aux_cts):
+                diff_vals = tuple(in_vals[i] for i in diff_idx)
+
+                def f(dv):
+                    iv = list(in_vals)
+                    for i, v in zip(diff_idx, dv):
+                        iv[i] = v
+                    return run(tuple(iv), rng)
+
+                _, vjp_fn = jax.vjp(f, diff_vals)
+                (cts_in,) = vjp_fn((cts_out, aux_cts))
+                return cts_in
+
+            self._fn_cache[key] = (jax.jit(bwd), diff_idx)
+        return self._fn_cache[key]
+
+    @staticmethod
+    def _dev_of(v):
+        devs = getattr(v, "devices", None)
+        return next(iter(devs())) if callable(devs) else None
+
+    def __call__(self, args_by_name, aux_by_name, rng, is_train,
+                 with_vjp=False):
+        env = {}
+        for name, v in args_by_name.items():
+            node = self.program._var_nodes.get(name)
+            if node is not None:
+                env[(id(node), 0)] = v
+        for name, v in aux_by_name.items():
+            node = self.program._var_nodes.get(name)
+            if node is not None:
+                env[(id(node), 0)] = v
+        new_aux = {}
+        saved = []
+        for si, (dev, _nodes) in enumerate(self.segments):
+            needs, out_keys, aux_names = self._seg_io[si]
+            for k in needs:
+                if k not in env:
+                    raise MXNetError(
+                        "executor: missing input for placed segment")
+            in_vals = tuple(jax.device_put(env[k], dev) for k in needs)
+            outs, aux_vals = self._seg_fn(si, is_train)(in_vals, rng)
+            if with_vjp:
+                saved.append((in_vals, aux_vals, rng))
+            env.update(zip(out_keys, outs))
+            new_aux.update(zip(aux_names, aux_vals))
+        outputs = [env[(id(n), i)] for n, i in self.program.output_entries]
+        for name in self.program.aux_names:
+            if name not in new_aux:
+                new_aux[name] = aux_by_name[name]
+        return outputs, new_aux, (env, saved)
+
+    def backward(self, env, saved, out_cts):
+        """Reverse pass over the segments; returns cotangent env keyed
+        like the forward env (var grads live at their var-node keys)."""
+        ct_env = {}
+
+        def _accum(k, ct):
+            if k in ct_env:
+                ct_env[k] = ct_env[k] + jax.device_put(
+                    ct, self._dev_of(ct_env[k]))
+            else:
+                ct_env[k] = ct
+
+        for (n, i), ct in zip(self.program.output_entries, out_cts):
+            _accum((id(n), i), ct)
+        for si in reversed(range(len(self.segments))):
+            dev, _nodes = self.segments[si]
+            needs, out_keys, _aux_names = self._seg_io[si]
+            in_vals, aux_vals, rng = saved[si]
+            bwd, diff_idx = self._seg_bwd_fn(si)
+            if not diff_idx:
+                continue  # nothing upstream of this segment needs grads
+            cts_out = tuple(
+                jax.device_put(ct_env[k], dev) if k in ct_env
+                else jnp.zeros_like(env[k])
+                for k in out_keys
+            )
+            aux_cts = tuple(jnp.zeros_like(a) for a in aux_vals)
+            cts_in = bwd(in_vals, rng, cts_out, aux_cts)
+            for i, ct in zip(diff_idx, cts_in):
+                _accum(needs[i], ct)
+        return ct_env
 
 
 def resolve_creation_shapes(symbol, shapes_by_name):
@@ -196,9 +411,39 @@ class Executor:
         self._needs_rng = any(
             (not n.is_variable) and n.op.needs_rng for n in self._program.nodes
         )
-        self._fwd_jit = self._make_fwd()
-        self._fwdbwd_jit = self._make_fwdbwd()
+        self._placed = self._build_placed()
+        if self._placed is not None:
+            self._fwd_jit = self._make_fwd_placed()
+            self._fwdbwd_jit = self._make_fwdbwd_placed()
+        else:
+            self._fwd_jit = self._make_fwd()
+            self._fwdbwd_jit = self._make_fwdbwd()
         self._pending_train_step = False
+
+    def _build_placed(self):
+        """ctx_group placement (reference AssignContext/PlaceDevice):
+        returns a _PlacedProgram when any node's ctx_group maps through
+        group2ctx to a device other than the bind ctx, else None (the
+        whole-graph single-device jit stays the fast path)."""
+        if not self._group2ctx:
+            return None
+        default_dev = self._ctx.jax_device
+        node_dev = {}
+        distinct = False
+        for node in self._program.nodes:
+            if node.is_variable:
+                continue
+            grp = (node.attrs.get("ctx_group")
+                   or node.attrs.get("__ctx_group__"))
+            ctx = self._group2ctx.get(grp) if grp else None
+            dev = ctx.jax_device if ctx is not None else default_dev
+            node_dev[id(node)] = dev
+            if dev != default_dev:
+                distinct = True
+        if not distinct:
+            return None
+        return _PlacedProgram(self._program, node_dev,
+                              grad_names=self._grad_names)
 
     @staticmethod
     def _resolve_creation_shapes(symbol, arg_arrays):
@@ -252,6 +497,53 @@ class Executor:
             zero_aux_ct = tuple(jnp.zeros_like(a) for a in new_aux)
             (grads,) = vjp_fn((cts, zero_aux_ct))
             return outs, new_aux, grads
+
+        return fwdbwd
+
+    def _make_fwd_placed(self):
+        placed = self._placed
+        arg_names = tuple(self._arg_names)
+        aux_names = tuple(self._aux_names)
+
+        def fwd(arg_vals, aux_vals, rng, is_train):
+            args = dict(zip(arg_names, arg_vals))
+            aux = dict(zip(aux_names, aux_vals))
+            outs, new_aux, _ = placed(args, aux, rng, is_train)
+            return tuple(outs), tuple(new_aux[n] for n in aux_names)
+
+        return fwd
+
+    def _make_fwdbwd_placed(self):
+        placed = self._placed
+        arg_names = tuple(self._arg_names)
+        aux_names = tuple(self._aux_names)
+        grad_names = tuple(self._grad_names)
+        var_nodes = self._program._var_nodes
+
+        def fwdbwd(arg_vals, aux_vals, rng, out_grads):
+            args = dict(zip(arg_names, arg_vals))
+            aux = dict(zip(aux_names, aux_vals))
+            outs, new_aux, (env, vjps) = placed(
+                args, aux, rng, True, with_vjp=True)
+            if out_grads is None:
+                cts = tuple(jnp.ones_like(o) for o in outs)
+            else:
+                cts = tuple(out_grads)
+            ct_env = placed.backward(env, vjps, cts)
+            grads = []
+            for name in grad_names:
+                key = (id(var_nodes[name]), 0)
+                ct = ct_env.get(key)
+                if ct is None:
+                    ct = jnp.zeros_like(args[name])
+                else:
+                    # grad lands where the param lives (its ctx_group
+                    # device), like reference arg_grad ctx assignment
+                    ct = jax.device_put(
+                        ct, _PlacedProgram._dev_of(args[name]))
+                grads.append(ct)
+            return (tuple(outs), tuple(new_aux[n] for n in aux_names),
+                    tuple(grads))
 
         return fwdbwd
 
@@ -460,10 +752,40 @@ class Executor:
         )
 
     @staticmethod
+    def _var_contexts(symbol, group2ctx):
+        """name -> Context for inputs with a ctx_group placement: a
+        variable's own ctx_group attr wins, else it inherits its first
+        consumer's group (reference AssignContext propagation,
+        graph_executor.cc:245-334)."""
+        if not group2ctx:
+            return {}
+        out = {}
+        nodes = _topo_order([n for n, _ in symbol._outputs])
+        for n in nodes:
+            if n.is_variable:
+                grp = (n.attrs.get("ctx_group")
+                   or n.attrs.get("__ctx_group__"))
+                if grp in group2ctx:
+                    out[n.name] = group2ctx[grp]
+        for n in nodes:
+            if n.is_variable:
+                continue
+            grp = (n.attrs.get("ctx_group")
+                   or n.attrs.get("__ctx_group__"))
+            if grp not in group2ctx:
+                continue
+            for (c, _i) in n.inputs:
+                if c.is_variable and c.name not in out:
+                    out[c.name] = group2ctx[grp]
+        return out
+
+    @staticmethod
     def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
                     group2ctx=None, shared_exec=None, **kwargs):
         """Infer shapes/types, allocate arg/grad/aux arrays, bind.
-        Parity: symbol.py:1114."""
+        Parity: symbol.py:1114. With group2ctx, params/grads allocate on
+        their group's device (reference simple_bind honors AssignContext
+        when allocating, symbol.py:1114-1210)."""
         if isinstance(ctx, (list, tuple)):
             ctx = ctx[0]
         if not isinstance(ctx, Context):
@@ -471,6 +793,7 @@ class Executor:
         arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
         arg_types, _, aux_types = symbol.infer_type(**(type_dict or {}))
         arg_names = symbol.list_arguments()
+        var_ctx = Executor._var_contexts(symbol, group2ctx)
         # share param arrays with shared_exec when shapes match (bucketing)
         shared = shared_exec.arg_dict if shared_exec is not None else {}
         arg_arrays = []
@@ -478,7 +801,8 @@ class Executor:
             if name in shared and tuple(shared[name].shape) == tuple(shape):
                 arg_arrays.append(shared[name])
             else:
-                arg_arrays.append(nd.zeros(shape, ctx=ctx, dtype=dtype))
+                arg_arrays.append(
+                    nd.zeros(shape, ctx=var_ctx.get(name, ctx), dtype=dtype))
         req_of = (
             (lambda n: grad_req)
             if isinstance(grad_req, str)
@@ -487,7 +811,8 @@ class Executor:
             else (lambda n: dict(zip(arg_names, grad_req)).get(n, "null"))
         )
         grad_arrays = [
-            nd.zeros(shape, ctx=ctx, dtype=dtype) if req_of(name) != "null" else None
+            nd.zeros(shape, ctx=var_ctx.get(name, ctx), dtype=dtype)
+            if req_of(name) != "null" else None
             for name, shape, dtype in zip(arg_names, arg_shapes, arg_types)
         ]
         shared_aux = shared_exec.aux_dict if shared_exec is not None else {}
@@ -497,7 +822,11 @@ class Executor:
             if name in shared_aux and tuple(shared_aux[name].shape) == tuple(shape):
                 aux_arrays.append(shared_aux[name])
             else:
-                aux_arrays.append(nd.zeros(shape, ctx=ctx, dtype=dtype))
+                # aux states (BN moving stats) live with their owning
+                # node's group too — _var_contexts covers them because
+                # aux vars appear among consumer-node inputs
+                aux_arrays.append(
+                    nd.zeros(shape, ctx=var_ctx.get(name, ctx), dtype=dtype))
         return Executor(
             symbol, ctx, arg_arrays, grad_arrays, grad_req, aux_arrays, group2ctx
         )

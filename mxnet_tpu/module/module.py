@@ -264,11 +264,16 @@ class Module(BaseModule):
             return
         # an explicit mesh IS the device set: its size (not the ctx list,
         # which only hosts the eval executors) decides whether a kvstore
-        # is needed at all (reference model.py:40 drops it for 1 device)
-        num_device = (self._mesh.size if self._mesh is not None
-                      else len(self._context))
+        # is needed at all (reference model.py:40 drops it for 1 device).
+        # With a mesh the request is explicit, so even a dp=1 mesh keeps
+        # its kvstore (dropping it would bounce the user off the fused
+        # path they asked for, with a misleading error).
+        if self._mesh is not None and isinstance(kvstore, str):
+            from ..kvstore import create as kv_create
+
+            kvstore = kv_create(kvstore)
         (kvstore, update_on_kvstore) = _create_kvstore(
-            kvstore, num_device, self._arg_params
+            kvstore, len(self._context), self._arg_params
         )
         batch_size = self._exec_group.batch_size
         if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:

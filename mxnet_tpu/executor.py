@@ -41,6 +41,12 @@ def _as_jax(x):
     return x._data if isinstance(x, NDArray) else x
 
 
+def _ctx_group(node):
+    """A node's placement group: accepts both the in-memory attr name and
+    the reference's serialized __ctx_group__ spelling (symbol.py:1183)."""
+    return node.attrs.get("ctx_group") or node.attrs.get("__ctx_group__")
+
+
 def _node_attrs(program, node, rng):
     """Execution-time attrs for one node — the ONE place where per-node
     execution semantics (shape overrides, CustomOp scoping keys, rng
@@ -432,9 +438,15 @@ class Executor:
         distinct = False
         for node in self._program.nodes:
             if node.is_variable:
+                # variable-only groups count too: simple_bind committed
+                # such params to their group's device, and the whole-
+                # graph jit would crash on mixed committed inputs
+                grp = _ctx_group(node)
+                ctx = self._group2ctx.get(grp) if grp else None
+                if ctx is not None and ctx.jax_device != default_dev:
+                    distinct = True
                 continue
-            grp = (node.attrs.get("ctx_group")
-                   or node.attrs.get("__ctx_group__"))
+            grp = _ctx_group(node)
             ctx = self._group2ctx.get(grp) if grp else None
             dev = ctx.jax_device if ctx is not None else default_dev
             node_dev[id(node)] = dev
@@ -763,15 +775,13 @@ class Executor:
         nodes = _topo_order([n for n, _ in symbol._outputs])
         for n in nodes:
             if n.is_variable:
-                grp = (n.attrs.get("ctx_group")
-                   or n.attrs.get("__ctx_group__"))
+                grp = _ctx_group(n)
                 if grp in group2ctx:
                     out[n.name] = group2ctx[grp]
         for n in nodes:
             if n.is_variable:
                 continue
-            grp = (n.attrs.get("ctx_group")
-                   or n.attrs.get("__ctx_group__"))
+            grp = _ctx_group(n)
             if grp not in group2ctx:
                 continue
             for (c, _i) in n.inputs:

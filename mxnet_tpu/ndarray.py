@@ -499,12 +499,22 @@ def waitall():
 
 
 # --------------------------------------------------------------------------
-# serialization — parity with NDArray::Save/Load (reference ndarray.cc):
-# our own container format (magic + names + raw tensors). The reference's
-# dmlc stream format is CUDA-era; we keep the same *semantics* (list or
-# dict of named arrays, round-trip exact).
+# serialization — BINARY-COMPATIBLE with NDArray::Save/Load (reference
+# src/ndarray/ndarray.cc:604-689 + python/mxnet/ndarray.py:2063-2097):
+# published .params files load here and files written here load in the
+# reference. Container layout (all little-endian):
+#   uint64 magic=0x112, uint64 reserved=0
+#   uint64 n_arrays, then per array (NDArray::Save):
+#     uint32 ndim, ndim x uint32 dims          (mshadow TShape::Save)
+#     int32 dev_type, int32 dev_id             (Context::Save; written 1,0)
+#     int32 type_flag                          (mshadow dtype code)
+#     raw contiguous data
+#   uint64 n_names, then per name: uint64 len + bytes
+# The round-1/2 private MXTPU001 container is still READ for backward
+# compatibility with checkpoints written by those rounds.
 # --------------------------------------------------------------------------
-_MAGIC = b"MXTPU001"
+_DMLC_MAGIC = 0x112
+_LEGACY_MAGIC = b"MXTPU001"
 
 
 def save(fname, data):
@@ -530,18 +540,37 @@ def _save_fileobj(f, data):
     else:
         names = []
         arrays = list(data)
-    f.write(_MAGIC)
-    f.write(struct.pack("<qq", len(arrays), len(names)))
+    f.write(struct.pack("<QQ", _DMLC_MAGIC, 0))
+    f.write(struct.pack("<Q", len(arrays)))
+    for a in arrays:
+        arr = np.ascontiguousarray(a.asnumpy())
+        if arr.ndim == 0:
+            # reference TShape cannot express 0-d (ndim 0 means "none")
+            raise MXNetError(
+                "cannot save 0-d NDArray in the .params format; "
+                "reshape to (1,) first")
+        code = mx_dtype_code(arr.dtype)
+        if code > 6:
+            # bfloat16 (code 12) is a TPU-era extension: the file still
+            # round-trips HERE, but reference MXNet's mshadow dtype
+            # switch only knows codes 0-6 and would abort loading it
+            import warnings
+
+            warnings.warn(
+                "saving dtype %s with extension code %d: this .params "
+                "file will not load in reference MXNet (cast to float32 "
+                "first for cross-compatibility)" % (arr.dtype, code),
+                stacklevel=3)
+        f.write(struct.pack("<I", arr.ndim))
+        f.write(struct.pack("<%dI" % arr.ndim, *arr.shape))
+        f.write(struct.pack("<ii", 1, 0))  # Context: cpu(0)
+        f.write(struct.pack("<i", code))
+        f.write(arr.tobytes())
+    f.write(struct.pack("<Q", len(names)))
     for n in names:
         b = n.encode()
-        f.write(struct.pack("<q", len(b)))
+        f.write(struct.pack("<Q", len(b)))
         f.write(b)
-    for a in arrays:
-        arr = a.asnumpy()
-        f.write(struct.pack("<q", mx_dtype_code(arr.dtype)))
-        f.write(struct.pack("<q", arr.ndim))
-        f.write(struct.pack("<%dq" % arr.ndim, *arr.shape))
-        f.write(np.ascontiguousarray(arr).tobytes())
 
 
 def load(fname):
@@ -558,11 +587,48 @@ def load_buffer(buf):
 
 
 def _load_fileobj(f, fname):
+    head = f.read(8)
+    if head == _LEGACY_MAGIC:
+        return _load_legacy(f, fname)
+    if len(head) < 8 or struct.unpack("<Q", head)[0] != _DMLC_MAGIC:
+        raise MXNetError("invalid NDArray file %s" % fname)
+    f.read(8)  # reserved
+    return _load_dmlc(f, fname)
+
+
+def _load_dmlc(f, fname):
     from .base import _DTYPE_MX_TO_NP
 
-    magic = f.read(len(_MAGIC))
-    if magic != _MAGIC:
-        raise MXNetError("invalid NDArray file %s" % fname)
+    (n_arr,) = struct.unpack("<Q", f.read(8))
+    arrays = []
+    for _ in range(n_arr):
+        (ndim,) = struct.unpack("<I", f.read(4))
+        if ndim == 0:
+            raise MXNetError("%s: empty (none) NDArray entry" % fname)
+        shape = struct.unpack("<%dI" % ndim, f.read(4 * ndim))
+        f.read(8)  # Context (dev_type, dev_id): arrays land on default ctx
+        (code,) = struct.unpack("<i", f.read(4))
+        if code not in _DTYPE_MX_TO_NP:
+            raise MXNetError("%s: unknown dtype code %d" % (fname, code))
+        dt = np.dtype(_DTYPE_MX_TO_NP[code])
+        count = int(np.prod(shape))
+        arr = np.frombuffer(
+            f.read(count * dt.itemsize), dtype=dt).reshape(shape)
+        arrays.append(array(arr, dtype=dt))
+    (n_names,) = struct.unpack("<Q", f.read(8))
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack("<Q", f.read(8))
+        names.append(f.read(ln).decode())
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def _load_legacy(f, fname):
+    """Round-1/2 MXTPU001 container (magic already consumed)."""
+    from .base import _DTYPE_MX_TO_NP
+
     n_arr, n_names = struct.unpack("<qq", f.read(16))
     names = []
     for _ in range(n_names):

@@ -1,0 +1,120 @@
+"""Reference .params container compatibility (VERDICT r2 item 5).
+
+The golden bytes are constructed BY HAND from the reference's documented
+layout (src/ndarray/ndarray.cc:604-689: magic 0x112 + dmlc vector of
+NDArray::Save records + dmlc vector of names; mshadow TShape = uint32
+ndim + uint32 dims; Context = 2x int32; dtype = int32 mshadow flag) —
+independently of the writer under test, so a writer/reader that agree
+with each other but not with the reference still fail here.
+"""
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _reference_params_bytes(entries):
+    """Build a .params file exactly as reference NDArray::Save does."""
+    out = [struct.pack("<QQ", 0x112, 0)]
+    out.append(struct.pack("<Q", len(entries)))
+    code = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+            "int32": 4}
+    for _name, arr in entries:
+        out.append(struct.pack("<I", arr.ndim))
+        out.append(struct.pack("<%dI" % arr.ndim, *arr.shape))
+        out.append(struct.pack("<ii", 1, 0))          # Context cpu(0)
+        out.append(struct.pack("<i", code[arr.dtype.name]))
+        out.append(np.ascontiguousarray(arr).tobytes())
+    out.append(struct.pack("<Q", len(entries)))
+    for name, _arr in entries:
+        b = name.encode()
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    return b"".join(out)
+
+
+def test_load_reference_format(tmp_path):
+    rng = np.random.RandomState(0)
+    entries = [
+        ("arg:fc1_weight", rng.randn(4, 3).astype(np.float32)),
+        ("arg:fc1_bias", rng.randn(4).astype(np.float16)),
+        ("aux:bn_moving_mean", rng.randn(4).astype(np.float64)),
+        ("aux:counts", rng.randint(0, 9, (2, 2)).astype(np.int32)),
+    ]
+    path = tmp_path / "ref.params"
+    path.write_bytes(_reference_params_bytes(entries))
+
+    loaded = mx.nd.load(str(path))
+    assert set(loaded) == {n for n, _ in entries}
+    for name, arr in entries:
+        got = loaded[name].asnumpy()
+        assert got.dtype == arr.dtype, name
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_save_produces_reference_bytes(tmp_path):
+    """Byte-exact: what we write IS what the reference writes."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(2, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    path = tmp_path / "out.params"
+    mx.nd.save(str(path), {"arg:w": mx.nd.array(w), "arg:b": mx.nd.array(b)})
+    expect = _reference_params_bytes([("arg:w", w), ("arg:b", b)])
+    assert path.read_bytes() == expect
+
+
+def test_list_save_load_roundtrip(tmp_path):
+    arrs = [mx.nd.array(np.arange(6).reshape(2, 3).astype(np.float32)),
+            mx.nd.ones((4,))]
+    path = tmp_path / "list.nd"
+    mx.nd.save(str(path), arrs)
+    back = mx.nd.load(str(path))
+    assert isinstance(back, list) and len(back) == 2
+    np.testing.assert_array_equal(back[0].asnumpy(), arrs[0].asnumpy())
+
+
+def test_legacy_mxtpu_container_still_loads(tmp_path):
+    """Checkpoints written by rounds 1-2 (MXTPU001) keep loading."""
+    arr = np.arange(4, dtype=np.float32).reshape(2, 2)
+    buf = [b"MXTPU001", struct.pack("<qq", 1, 1)]
+    name = b"arg:w"
+    buf.append(struct.pack("<q", len(name)))
+    buf.append(name)
+    buf.append(struct.pack("<q", 0))  # float32
+    buf.append(struct.pack("<q", arr.ndim))
+    buf.append(struct.pack("<%dq" % arr.ndim, *arr.shape))
+    buf.append(arr.tobytes())
+    path = tmp_path / "legacy.params"
+    path.write_bytes(b"".join(buf))
+    loaded = mx.nd.load(str(path))
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(), arr)
+
+
+def test_module_checkpoint_roundtrip_scores_identically(tmp_path):
+    rng = np.random.RandomState(2)
+    X = rng.randn(64, 5).astype(np.float32)
+    y = (rng.rand(64) * 3).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            num_epoch=2)
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 2)
+
+    # the params file on disk is reference-format (magic 0x112)
+    with open(prefix + "-0002.params", "rb") as f:
+        assert struct.unpack("<Q", f.read(8))[0] == 0x112
+
+    mod2 = mx.mod.Module.load(prefix, 2)
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    it.reset()
+    s1 = dict(mod.score(it, mx.metric.Accuracy()))
+    it.reset()
+    s2 = dict(mod2.score(it, mx.metric.Accuracy()))
+    assert s1 == s2

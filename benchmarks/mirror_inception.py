@@ -1,0 +1,120 @@
+"""Memory-mirror proof on real TPU: inception-v3 batch 128 (BASELINE.md
+row 'inception-v3 w/ memory mirror (batch 32->128)': the reference fits
+batch 128 on a 12 GB K80 only with MXNET_BACKWARD_DO_MIRROR=1 at a
+30->27 img/s cost, example/image-classification/README.md:357-359).
+
+Runs the fused train step with and without the mirror and prints ONE
+JSON line: compiled temp memory (XLA memory_analysis) and step time for
+both. Expected: mirror cuts activation temp memory materially, costing
+some recompute throughput — mirroring (pun intended) the reference's
+tradeoff. Usage: python benchmarks/mirror_inception.py [batch]
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(mirror, batch):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.executor import _GraphProgram
+    from mxnet_tpu.models.inception_v3 import get_symbol
+
+    if mirror:
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    else:
+        os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+
+    sym = get_symbol(num_classes=1000)
+    program = _GraphProgram(sym)
+    data_shape = (batch, 3, 299, 299)
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=data_shape, softmax_label=(batch,))
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    rng = np.random.RandomState(0)
+    params = {}
+    for n, s in zip(arg_names, arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        if n.endswith("_gamma"):
+            params[n] = np.ones(s, np.float32)
+        elif n.endswith(("_beta", "_bias")):
+            params[n] = np.zeros(s, np.float32)
+        else:
+            fan_in = int(np.prod(s[1:])) or 1
+            params[n] = (rng.randn(*s) * np.sqrt(2.0 / fan_in)).astype(
+                np.float32)
+    aux = {n: (np.ones(s, np.float32) if n.endswith("var")
+               else np.zeros(s, np.float32))
+           for n, s in zip(aux_names, aux_shapes)}
+
+    from mxnet_tpu.executor import _mirror_enabled, _mirror_policy
+
+    do_mirror = _mirror_enabled(program)
+    assert do_mirror == mirror
+
+    def train_step(params, aux, data, label):
+        def loss_fn(ps):
+            args = dict(ps)
+            args["data"] = data
+            args["softmax_label"] = label
+            outs, new_aux = program(args, aux, None, True)
+            return jnp.sum(outs[0]), new_aux
+
+        if do_mirror:
+            loss_fn = jax.checkpoint(loss_fn, policy=_mirror_policy)
+        grads, new_aux = jax.grad(loss_fn, has_aux=True)(params)
+        new_params = {n: params[n] - 0.01 * grads[n] for n in params}
+        return new_params, new_aux
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    data = jnp.asarray(rng.rand(*data_shape), jnp.float32)
+    label = jnp.asarray(rng.randint(0, 1000, batch), jnp.float32)
+    params = {k: jnp.asarray(v) for k, v in params.items()}
+    aux = {k: jnp.asarray(v) for k, v in aux.items()}
+    return step, params, aux, data, label
+
+
+def measure(mirror, batch, steps=5):
+    import jax
+
+    step, params, aux, data, label = build_step(mirror, batch)
+    t0 = time.perf_counter()
+    compiled = step.lower(params, aux, data, label).compile()
+    compile_s = time.perf_counter() - t0
+    mem = compiled.memory_analysis()
+    params, aux = compiled(params, aux, data, label)  # warm
+    # force completion via scalar fetch (axon block_until_ready lies)
+    float(list(params.values())[0].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, aux = compiled(params, aux, data, label)
+    float(list(params.values())[0].ravel()[0])
+    dt = (time.perf_counter() - t0) / steps
+    return {
+        "temp_bytes": int(mem.temp_size_in_bytes),
+        "step_ms": round(1000 * dt, 1),
+        "img_s": round(batch / dt, 1),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    out = {"model": "inception_v3", "batch": batch}
+    out["plain"] = measure(False, batch)
+    out["mirror"] = measure(True, batch)
+    out["temp_ratio"] = round(
+        out["mirror"]["temp_bytes"] / max(out["plain"]["temp_bytes"], 1), 3)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

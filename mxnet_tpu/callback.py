@@ -1,99 +1,127 @@
-"""Training callbacks.
+"""Training-loop callbacks.
 
-Parity: reference ``python/mxnet/callback.py`` (Speedometer — the
-samples/sec logger behind all reference benchmarks — do_checkpoint,
-module_checkpoint, log_train_metric, ProgressBar).
+Capability parity with reference ``python/mxnet/callback.py``
+(Speedometer — the samples/sec logger behind every reference benchmark —
+do_checkpoint, module_checkpoint, log_train_metric, ProgressBar),
+re-designed around two small shared pieces: a ``_every`` period gate and
+a ``_Throughput`` timer, instead of open-coded state in each callback.
+Log message formats match the reference (they are observable output that
+downstream log scrapers parse).
 """
 from __future__ import annotations
 
 import logging
-import math
 import sys
 import time
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    period = int(max(1, period))
+def _every(period, fn):
+    """Epoch-end callback firing fn on each period-th (1-based) epoch."""
+    period = max(1, int(period))
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+    def _callback(iter_no, *state):
+        epoch = iter_no + 1
+        if epoch % period == 0:
+            fn(epoch, *state)
 
     return _callback
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Checkpoint a Module every ``period`` epochs."""
+    return _every(
+        period,
+        lambda epoch, *_s: mod.save_checkpoint(
+            prefix, epoch, save_optimizer_states),
+    )
 
 
 def do_checkpoint(prefix, period=1):
+    """Checkpoint (symbol, args, aux) every ``period`` epochs — the
+    epoch_end_callback shape fit() passes (iter_no, sym, arg, aux)."""
     from .model import save_checkpoint
 
-    period = int(max(1, period))
-
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-
-    return _callback
+    return _every(
+        period,
+        lambda epoch, sym, arg, aux: save_checkpoint(
+            prefix, epoch, sym, arg, aux),
+    )
 
 
 def log_train_metric(period, auto_reset=False):
+    """Log the running training metric every ``period`` batches."""
+
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info(
-                    "Iter[%d] Batch[%d] Train-%s=%f",
-                    param.epoch, param.nbatch, name, value
-                )
+            for name, value in param.eval_metric.get_name_value():
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
             if auto_reset:
                 param.eval_metric.reset()
 
     return _callback
 
 
+class _Throughput:
+    """Samples/sec over a window; restarts cleanly on epoch rollover."""
+
+    def __init__(self, batch_size):
+        self.batch_size = batch_size
+        self._since = None
+        self._last_batch = 0
+
+    def rate(self, nbatch):
+        """None until a full window has elapsed, else samples/sec."""
+        now = time.time()
+        if self._since is None or nbatch < self._last_batch:
+            self._since = now
+            self._last_batch = nbatch
+            return None
+        elapsed = max(now - self._since, 1e-12)
+        n_batches = nbatch - self._last_batch
+        self._since = now
+        self._last_batch = nbatch
+        return n_batches * self.batch_size / elapsed
+
+
 class Speedometer(object):
-    """Log training speed every `frequent` batches."""
+    """Log throughput (and the running metric, which it resets) every
+    ``frequent`` batches — the number all BASELINE.md rows quote."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._meter = _Throughput(batch_size)
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
-                    for name, value in name_value:
-                        logging.info(
-                            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t"
-                            "Train-%s=%f", param.epoch, count, speed, name, value
-                        )
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed
-                    )
-                self.tic = time.time()
+        nbatch = param.nbatch
+        if nbatch % self.frequent != 0 and nbatch >= self._meter._last_batch:
+            return
+        speed = self._meter.rate(nbatch)
+        if speed is None:
+            return
+        if param.eval_metric is not None:
+            name_values = param.eval_metric.get_name_value()
+            param.eval_metric.reset()
+            for name, value in name_values:
+                logging.info(
+                    "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t"
+                    "Train-%s=%f", param.epoch, nbatch, speed, name, value)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, nbatch, speed)
 
 
 class ProgressBar(object):
+    """Render batch progress as a fixed-width terminal bar."""
+
     def __init__(self, total, length=80):
         self.bar_len = length
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+        frac = param.nbatch / float(self.total)
+        done = int(round(self.bar_len * frac))
+        pct = -(-100 * param.nbatch // self.total)  # ceil
+        sys.stdout.write("[%s] %s%%\r" % (
+            "=" * done + "-" * (self.bar_len - done), pct))

@@ -47,6 +47,43 @@ def _ctx_group(node):
     return node.attrs.get("ctx_group") or node.attrs.get("__ctx_group__")
 
 
+def _mirror_enabled(program):
+    """Whole-graph gradient-checkpoint switch: the env flag only
+    (reference MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:213-226).
+    Per-node __force_mirroring__ attrs remat just their own node — see
+    _compute_node — so one flagged activation doesn't silently escalate
+    to whole-model recompute."""
+    from .base import get_env
+
+    return bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0))
+
+
+def _force_mirrored(node):
+    return node.attrs.get("__force_mirroring__") in ("True", "true", "1")
+
+
+def _compute_node(node, attrs, in_vals, is_train):
+    """Run one node's fcompute; a node carrying __force_mirroring__
+    recomputes (only) itself in backward via jax.checkpoint — the
+    per-node escape hatch the reference's need_mirror honors first."""
+    if is_train and _force_mirrored(node):
+        fn = jax.checkpoint(
+            lambda *iv: node.op.fcompute(attrs, list(iv), is_train))
+        return fn(*in_vals)
+    return node.op.fcompute(attrs, in_vals, is_train)
+
+
+def _mirror_policy(prim, *_args, **_params):
+    """Which residuals to SAVE under memory mirroring. The reference
+    recomputes every op in backward except Convolution / FullyConnected /
+    Concat / SoftmaxOutput (graph_executor.cc need_mirror) — i.e. keep
+    the MXU-expensive results, rematerialize the bandwidth-cheap ones
+    (activations, BN, pooling). The XLA translation: save dot/conv
+    primitive outputs, recompute everything else. (Dropout recompute is
+    safe here: masks come from deterministic per-node fold_in keys.)"""
+    return prim.name in ("dot_general", "conv_general_dilated")
+
+
 def _node_attrs(program, node, rng):
     """Execution-time attrs for one node — the ONE place where per-node
     execution semantics (shape overrides, CustomOp scoping keys, rng
@@ -115,7 +152,7 @@ class _GraphProgram:
                 continue
             attrs = _node_attrs(self, node, rng)
             in_vals = [env[(id(c), i)] for (c, i) in node.inputs]
-            results = node.op.fcompute(attrs, in_vals, is_train)
+            results = _compute_node(node, attrs, in_vals, is_train)
             n_outs = node.num_outputs()
             for i, v in enumerate(results[:n_outs]):
                 env[(id(node), i)] = v
@@ -242,7 +279,7 @@ class _PlacedProgram:
             for node in nodes:
                 attrs = _node_attrs(program, node, rng)
                 ins = [env[(id(c), i)] for (c, i) in node.inputs]
-                results = node.op.fcompute(attrs, ins, is_train)
+                results = _compute_node(node, attrs, ins, is_train)
                 n_outs = node.num_outputs()
                 for i, v in enumerate(results[:n_outs]):
                     env[(id(node), i)] = v
@@ -488,6 +525,8 @@ class Executor:
         aux_names = tuple(self._aux_names)
         grad_names = tuple(self._grad_names)
 
+        do_mirror = _mirror_enabled(program)
+
         @jax.jit
         def fwdbwd(arg_vals, aux_vals, rng, out_grads):
             args = dict(zip(arg_names, arg_vals))
@@ -499,6 +538,11 @@ class Executor:
                 a.update(dict(zip(grad_names, diff_vals)))
                 outs, new_aux = program(a, aux, rng, True)
                 return tuple(outs), tuple(new_aux[n] for n in aux_names)
+
+            if do_mirror:
+                # memory mirror: trade recompute FLOPs for activation
+                # memory exactly where the reference does
+                f = jax.checkpoint(f, policy=_mirror_policy)
 
             diff_vals = tuple(args[n] for n in grad_names)
             (outs, new_aux), vjp_fn = jax.vjp(f, diff_vals)

@@ -1,7 +1,12 @@
-"""Learning-rate schedulers.
+"""Learning-rate schedules.
 
-Parity: reference ``python/mxnet/lr_scheduler.py`` (FactorScheduler,
-MultiFactorScheduler).
+Capability parity with reference ``python/mxnet/lr_scheduler.py``
+(FactorScheduler, MultiFactorScheduler), re-designed as CLOSED-FORM
+functions of ``num_update`` instead of the reference's stateful
+while-loop mutation: the lr for any update count is computed directly,
+which makes schedules idempotent (safe to re-evaluate for the fused
+train step's per-step host lr) and trivially resumable from a
+checkpointed update count.
 """
 from __future__ import annotations
 
@@ -9,14 +14,35 @@ import logging
 
 
 class LRScheduler:
+    """Maps a global update count to a learning rate. ``base_lr`` is
+    assigned by the owning Optimizer (optimizer.py sets it from its own
+    learning_rate at construction)."""
+
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
+        self._last_stage = 0
+
+    def _stage(self, num_update):
+        """How many decay boundaries lie strictly below num_update."""
+        raise NotImplementedError()
+
+    def _lr_at_stage(self, k):
+        raise NotImplementedError()
 
     def __call__(self, num_update):
-        raise NotImplementedError()
+        k = self._stage(num_update)
+        lr = self._lr_at_stage(k)
+        if k != self._last_stage:
+            self._last_stage = k
+            logging.info("Update[%d]: Change learning rate to %0.5e",
+                         num_update, lr)
+        return lr
 
 
 class FactorScheduler(LRScheduler):
+    """lr = base_lr * factor^(floor((num_update-1)/step)), floored at
+    ``stop_factor_lr``."""
+
     def __init__(self, step, factor=1.0, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
@@ -26,52 +52,33 @@ class FactorScheduler(LRScheduler):
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info(
-                    "Update[%d]: now learning rate arrived at %0.5e, will not "
-                    "change in the future", num_update, self.base_lr
-                )
-            else:
-                logging.info(
-                    "Update[%d]: Change learning rate to %0.5e",
-                    num_update, self.base_lr
-                )
-        return self.base_lr
+    def _stage(self, num_update):
+        return max(0, num_update - 1) // self.step
+
+    def _lr_at_stage(self, k):
+        return max(self.stop_factor_lr, self.base_lr * self.factor ** k)
 
 
 class MultiFactorScheduler(LRScheduler):
+    """Decay by ``factor`` at each boundary in the increasing list
+    ``step`` (boundaries are update counts, exclusive)."""
+
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list")
+        if any(s < 1 for s in step):
+            raise ValueError("Schedule step must be greater or equal than 1")
+        if any(b >= a for a, b in zip(step[1:], step)):
+            raise ValueError("Schedule step must be an increasing integer list")
         if factor > 1.0:
             raise ValueError("Factor must be no more than 1 to make lr reduce")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info(
-                    "Update[%d]: Change learning rate to %0.5e",
-                    num_update, self.base_lr
-                )
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _stage(self, num_update):
+        return sum(1 for boundary in self.step if num_update > boundary)
+
+    def _lr_at_stage(self, k):
+        return self.base_lr * self.factor ** k

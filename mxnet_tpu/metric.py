@@ -1,8 +1,12 @@
-"""Evaluation metrics.
+"""Evaluation metrics — streaming (sum, count) accumulators.
 
-Parity: reference ``python/mxnet/metric.py`` (EvalMetric hierarchy:
-Accuracy, TopKAccuracy, F1, Perplexity, MAE, MSE, RMSE, CrossEntropy,
-CompositeEvalMetric, CustomMetric, np wrapper).
+Capability parity with reference ``python/mxnet/metric.py`` (the
+EvalMetric hierarchy and ``create``/``np`` factories), re-designed
+rather than transcribed: every metric is a vectorized per-batch scoring
+hook (``_score(label, pred) -> (sum, count)``) behind ONE shared update
+pipeline that does the device→host conversion once. No per-sample
+python loops anywhere — F1 comes from whole-batch confusion counts,
+top-k from a single argpartition, perplexity from take_along_axis.
 """
 from __future__ import annotations
 
@@ -10,265 +14,205 @@ import math
 
 import numpy
 
-from .base import MXNetError
-from . import ndarray as nd
 from .ndarray import NDArray
 
 
 def check_label_shapes(labels, preds, shape=0):
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    """Raise on label/pred arity (or shape, with shape=1) mismatch."""
+    a = len(labels) if shape == 0 else labels.shape
+    b = len(preds) if shape == 0 else preds.shape
+    if a != b:
         raise ValueError(
-            "Shape of labels {} does not match shape of predictions {}".format(
-                label_shape, pred_shape
-            )
-        )
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(a, b))
+
+
+def _host(x):
+    """One conversion point: NDArray/jax array -> numpy."""
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
 class EvalMetric(object):
+    """Base accumulator. Subclasses implement ``_score(label, pred)``
+    returning a (metric_sum, instance_count) pair per output batch; the
+    base class owns conversion, accumulation, and reporting. The
+    ``num``-slot variant (one counter per output) is kept for heads that
+    report per-output values (e.g. detection losses)."""
+
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
         self.reset()
 
-    def update(self, labels, preds):
+    # -- subclass hook --------------------------------------------------
+    def _score(self, label, pred):
         raise NotImplementedError()
 
-    def reset(self):
+    # -- shared pipeline ------------------------------------------------
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
         if self.num is None:
-            self.num_inst = 0
-            self.sum_metric = 0.0
+            for label, pred in zip(labels, preds):
+                s, n = self._score(_host(label), _host(pred))
+                self.sum_metric += s
+                self.num_inst += n
         else:
-            self.num_inst = [0] * self.num
-            self.sum_metric = [0.0] * self.num
+            for i, (label, pred) in enumerate(zip(labels, preds)):
+                s, n = self._score(_host(label), _host(pred))
+                self.sum_metric[i] += s
+                self.num_inst[i] += n
+
+    def reset(self):
+        zero = (0.0, 0) if self.num is None else (
+            [0.0] * self.num, [0] * self.num)
+        self.sum_metric, self.num_inst = zero[0], zero[1]
+
+    def _ratio(self, s, n):
+        return s / n if n else float("nan")
 
     def get(self):
         if self.num is None:
-            if self.num_inst == 0:
-                return (self.name, float("nan"))
-            return (self.name, self.sum_metric / self.num_inst)
-        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
-        values = [
-            x / y if y != 0 else float("nan")
-            for x, y in zip(self.sum_metric, self.num_inst)
-        ]
-        return (names, values)
+            return (self.name, self._ratio(self.sum_metric, self.num_inst))
+        return (
+            ["%s_%d" % (self.name, i) for i in range(self.num)],
+            [self._ratio(s, n)
+             for s, n in zip(self.sum_metric, self.num_inst)],
+        )
 
     def get_name_value(self):
         name, value = self.get()
         if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
+            name, value = [name], [value]
         return list(zip(name, value))
 
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
 
-class CompositeEvalMetric(EvalMetric):
-    def __init__(self, **kwargs):
-        super().__init__("composite")
-        try:
-            self.metrics = kwargs["metrics"]
-        except KeyError:
-            self.metrics = []
-
-    def add(self, metric):
-        self.metrics.append(metric)
-
-    def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            return ValueError("Metric index {} is out of range".format(index))
-
-    def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
-
-    def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
-
-    def get(self):
-        names = []
-        results = []
-        for metric in self.metrics:
-            result = metric.get()
-            names.append(result[0])
-            results.append(result[1])
-        return (names, results)
+def _as_class_ids(label, pred):
+    """Hard class ids from (label, pred): argmax pred over the channel
+    axis when it still carries probabilities."""
+    pred_ids = pred if pred.shape == label.shape else pred.argmax(axis=1)
+    return label.astype("int64").ravel(), pred_ids.astype("int64").ravel()
 
 
 class Accuracy(EvalMetric):
     def __init__(self):
         super().__init__("accuracy")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            if pred_label.shape != label.shape:
-                pred_label = nd.argmax_channel(pred_label)
-            pred_label = pred_label.asnumpy().astype("int32")
-            label = label.asnumpy().astype("int32")
-            check_label_shapes(label, pred_label)
-            self.sum_metric += (pred_label.flat == label.flat).sum()
-            self.num_inst += len(pred_label.flat)
+    def _score(self, label, pred):
+        lab, ids = _as_class_ids(label, pred)
+        check_label_shapes(lab, ids, shape=1)
+        return int((ids == lab).sum()), lab.size
 
 
 class TopKAccuracy(EvalMetric):
-    def __init__(self, **kwargs):
-        super().__init__("top_k_accuracy")
-        try:
-            self.top_k = kwargs["top_k"]
-        except KeyError:
-            self.top_k = 1
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+    """Hit if the true class is among the k highest-scoring classes."""
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            label = label.asnumpy().astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flat == label.flat
-                    ).sum()
-            self.num_inst += num_samples
+    def __init__(self, **kwargs):
+        self.top_k = kwargs.get("top_k", 1)
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        super().__init__("top_k_accuracy_%d" % self.top_k)
+
+    def _score(self, label, pred):
+        assert pred.ndim <= 2, "Predictions should be no more than 2 dims"
+        lab = label.astype("int64").ravel()
+        if pred.ndim == 1:
+            return int((pred.astype("int64") == lab).sum()), lab.size
+        k = min(self.top_k, pred.shape[1])
+        # one partial sort per batch: top-k columns, order irrelevant
+        topk = numpy.argpartition(pred, -k, axis=1)[:, -k:]
+        hit = (topk == lab[:, None]).any(axis=1)
+        return int(hit.sum()), lab.size
 
 
 class F1(EvalMetric):
+    """Binary F1 from whole-batch confusion counts; accumulated as one
+    score per batch (matching the reference's averaging convention)."""
+
     def __init__(self):
         super().__init__("f1")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(numpy.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            true_positives, false_positives, false_negatives = 0.0, 0.0, 0.0
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.0
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.0
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.0
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.0
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.0
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.0
-            self.sum_metric += f1_score
-            self.num_inst += 1
+    def _score(self, label, pred):
+        lab, ids = _as_class_ids(label, pred)
+        if numpy.unique(lab).size > 2:
+            raise ValueError(
+                "F1 currently only supports binary classification.")
+        tp = int(((ids == 1) & (lab == 1)).sum())
+        fp = int(((ids == 1) & (lab == 0)).sum())
+        fn = int(((ids == 0) & (lab == 1)).sum())
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return f1, 1
 
 
 class Perplexity(EvalMetric):
-    """Perplexity over softmax outputs (reference metric.py:374)."""
+    """exp of the mean negative log-probability of the true tokens,
+    with an optional ignored (padding) label id."""
 
     def __init__(self, ignore_label, axis=-1):
         super().__init__("Perplexity")
         self.ignore_label = ignore_label
         self.axis = axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            assert label.size == pred.size / pred.shape[-1], (
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            )
-            label = label.as_in_context(pred.context).astype(numpy.int32).reshape((label.size,))
-            pred_np = pred.asnumpy().reshape(-1, pred.shape[-1])
-            label_np = label.asnumpy().astype("int32")
-            probs = pred_np[numpy.arange(label_np.shape[0]), label_np]
-            if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label).astype(probs.dtype)
-                num -= int(ignore.sum())
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label_np.shape[0]
-        self.sum_metric += loss
-        self.num_inst += num
+    def _score(self, label, pred):
+        n_class = pred.shape[-1]
+        assert label.size == pred.size // n_class, (
+            "shape mismatch: %s vs. %s" % (label.shape, pred.shape))
+        flat = pred.reshape(-1, n_class)
+        ids = label.astype("int64").reshape(-1, 1)
+        probs = numpy.take_along_axis(flat, ids, axis=1).ravel()
+        count = ids.size
+        if self.ignore_label is not None:
+            keep = (ids.ravel() != self.ignore_label)
+            probs = numpy.where(keep, probs, 1.0)
+            count = int(keep.sum())
+        nll = -numpy.log(numpy.maximum(probs, 1e-10)).sum()
+        return float(nll), count
 
     def get(self):
-        if self.num_inst == 0:
+        if not self.num_inst:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
-class MAE(EvalMetric):
+class _Regression(EvalMetric):
+    """Shared shape handling for elementwise regression metrics: a 1-d
+    label broadcasts against (N, 1) predictions, one score per batch."""
+
+    def _score(self, label, pred):
+        if label.ndim == 1:
+            label = label[:, None]
+        return float(self._agg(label, pred)), 1
+
+
+class MAE(_Regression):
     def __init__(self):
         super().__init__("mae")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _agg(label, pred):
+        return numpy.abs(label - pred).mean()
 
 
-class MSE(EvalMetric):
+class MSE(_Regression):
     def __init__(self):
         super().__init__("mse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _agg(label, pred):
+        return numpy.square(label - pred).mean()
 
 
-class RMSE(EvalMetric):
+class RMSE(_Regression):
     def __init__(self):
         super().__init__("rmse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    @staticmethod
+    def _agg(label, pred):
+        return math.sqrt(numpy.square(label - pred).mean())
 
 
 class CrossEntropy(EvalMetric):
@@ -276,23 +220,21 @@ class CrossEntropy(EvalMetric):
         super().__init__("cross-entropy")
         self.eps = eps
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def _score(self, label, pred):
+        lab = label.ravel().astype("int64")
+        assert lab.shape[0] == pred.shape[0]
+        probs = pred[numpy.arange(lab.size), lab]
+        return float(-numpy.log(probs + self.eps).sum()), lab.size
 
 
 class CustomMetric(EvalMetric):
+    """Adapter for a user eval fn of (label_np, pred_np); the fn may
+    return a bare score (counted per batch) or a (sum, count) pair."""
+
     def __init__(self, feval, name=None, allow_extra_outputs=False):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name)
         self._feval = feval
@@ -301,52 +243,76 @@ class CustomMetric(EvalMetric):
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.num_inst += 1
+        EvalMetric.update(
+            self, list(labels)[:len(preds)], list(preds)[:len(labels)])
+
+    def _score(self, label, pred):
+        out = self._feval(label, pred)
+        return out if isinstance(out, tuple) else (out, 1)
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Fan-out wrapper over child metrics."""
+
+    def __init__(self, **kwargs):
+        super().__init__("composite")
+        self.metrics = list(kwargs.get("metrics", []))
+
+    def add(self, metric):
+        self.metrics.append(metric)
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            raise ValueError(
+                "Metric index {} is out of range".format(index))
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        pairs = [m.get() for m in self.metrics]
+        return ([n for n, _ in pairs], [v for _, v in pairs])
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy eval function (reference metric.py np)."""
+    """Wrap a bare numpy eval function as a metric."""
+    metric = CustomMetric(numpy_feval, name, allow_extra_outputs)
+    return metric
 
-    def feval(label, pred):
-        return numpy_feval(label, pred)
 
-    feval.__name__ = numpy_feval.__name__
-    return CustomMetric(feval, name, allow_extra_outputs)
+_REGISTRY = {
+    "acc": Accuracy,
+    "accuracy": Accuracy,
+    "ce": CrossEntropy,
+    "f1": F1,
+    "mae": MAE,
+    "mse": MSE,
+    "rmse": RMSE,
+    "top_k_accuracy": TopKAccuracy,
+}
 
 
 def create(metric, **kwargs):
+    """str name / callable / EvalMetric / list -> EvalMetric."""
     if callable(metric):
         return CustomMetric(metric)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, **kwargs))
-        return composite_metric
-    metrics = {
-        "acc": Accuracy,
-        "accuracy": Accuracy,
-        "ce": CrossEntropy,
-        "f1": F1,
-        "mae": MAE,
-        "mse": MSE,
-        "rmse": RMSE,
-        "top_k_accuracy": TopKAccuracy,
-    }
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, **kwargs))
+        return out
     try:
-        klass = metrics[metric.lower()]
+        cls = _REGISTRY[metric.lower()]
     except KeyError:
         raise ValueError("Metric must be either callable or in {}".format(
-            sorted(metrics.keys())))
-    return klass(**kwargs)
+            sorted(_REGISTRY)))
+    return cls(**kwargs)

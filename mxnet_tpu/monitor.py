@@ -1,10 +1,13 @@
 """Tensor-level training monitor.
 
-Parity: reference ``python/mxnet/monitor.py`` (installs an executor output
-callback, aggregates stats by regex). The reference copies every op output
-via Executor::SetMonitorCallback (graph_executor.cc:760); here the
-executor's monitor hook feeds the same interface.
-"""
+Capability parity with reference ``python/mxnet/monitor.py``: install on
+executors, collect a statistic of every op output whose name matches a
+pattern on every ``interval``-th step, plus the matching weights at
+``toc``. The reference pipes executor outputs through
+Executor::SetMonitorCallback (graph_executor.cc:760); here the
+executor's monitor hook feeds the same records. Re-designed around an
+explicit record list and a single ``_format`` path rather than the
+reference's queue/string concatenation."""
 from __future__ import annotations
 
 import logging
@@ -15,73 +18,82 @@ from . import ndarray as nd
 from .ndarray import NDArray
 
 
+def _rms(x):
+    """Default statistic: ||x||_2 / sqrt(n) — scale-free activation/
+    weight magnitude."""
+    return nd.norm(x) / sqrt(x.size)
+
+
 class Monitor(object):
+    """Collects (step, tensor_name, stat) records while activated.
+
+    Use: ``mon.install(exe)`` once per executor, then per batch
+    ``mon.tic()`` before forward and ``mon.toc()``/``toc_print()`` after.
+    """
+
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-
-            def asum_stat(x):
-                return nd.norm(x) / sqrt(x.size)
-
-            stat_func = asum_stat
-        self.stat_func = stat_func
+        self.stat_func = stat_func or _rms
         self.interval = interval
+        self.sort = sort
+        self._pattern = re.compile(pattern)
         self.activated = False
-        self.queue = []
         self.step = 0
         self.exes = []
-        self.re_prog = re.compile(pattern)
-        self.sort = sort
+        self._records = []
+        # bound hook the executor calls with every op output
+        self.stat_helper = self._observe
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
-
-        self.stat_helper = stat_helper
+    def _observe(self, name, array):
+        if self.activated and self._pattern.match(name):
+            self._records.append((self.step, name, self.stat_func(array)))
 
     def install(self, exe):
         exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 
+    def _sync(self):
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+
     def tic(self):
+        """Arm collection if this step is on the interval."""
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
+            self._sync()
+            self._records = []
             self.activated = True
         self.step += 1
 
     def toc(self):
+        """Disarm and return [(step, name, formatted stat)] — including
+        a stat of each matching weight, not just op outputs."""
         if not self.activated:
             return []
+        self._sync()
         for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in zip(exe._symbol.list_arguments(), exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays):
+                if self._pattern.match(name):
+                    self._records.append(
+                        (self.step, name, self.stat_func(array)))
         self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,):
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
-        self.queue = []
-        return res
+        records = sorted(self._records, key=lambda r: r[1]) if self.sort \
+            else self._records
+        out = [(step, name, self._format(stat))
+               for step, name, stat in records]
+        self._records = []
+        return out
+
+    @staticmethod
+    def _format(stat):
+        vals = [stat] if isinstance(stat, NDArray) else stat
+        assert isinstance(vals, list)
+        parts = []
+        for v in vals:
+            assert isinstance(v, NDArray)
+            parts.append(str(v.asscalar() if v.shape == (1,) else v.asnumpy()))
+        return "\t".join(parts) + "\t"
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: {:7d} {:30s} {:s}".format(n, k, v))
+        for step, name, val in self.toc():
+            logging.info("Batch: {:7d} {:30s} {:s}".format(step, name, val))

@@ -1,23 +1,21 @@
 """Optimizers.
 
-Parity: reference ``python/mxnet/optimizer.py`` — registry +
-SGD/NAG/SGLD/ccSGD/Adam/AdaGrad/RMSProp/AdaDelta/Ftrl/DCASGD/Test, the
-``Updater`` closure used for local updates, lr/wd multipliers, gradient
-clipping, lr_scheduler integration. SGD/Adam/RMSProp route through the
-fused update ops (``mxnet_tpu.ops.optimizer_ops``, parity
-src/operator/tensor/optimizer_op.cc) so the update is one XLA kernel.
+Capability parity with reference ``python/mxnet/optimizer.py`` (registry
++ SGD/NAG/SGLD/ccSGD/Adam/AdaGrad/RMSProp/AdaDelta/Ftrl/DCASGD/Test, the
+``Updater`` closure, lr/wd multipliers, clipping, lr_scheduler wiring),
+re-designed around one shared update pipeline: ``_begin_update`` hands
+every eager optimizer its (lr, wd, conditioned grad) so the per-class
+code is only the algorithm's state math. SGD/Adam/RMSProp instead route
+through the fused update ops (``mxnet_tpu.ops.optimizer_ops``, parity
+src/operator/tensor/optimizer_op.cc) — one XLA kernel per update, and
+the same path the fused ShardedTrainStep traces through.
 """
 from __future__ import annotations
 
 import logging
-import math
 import pickle
 
-import numpy as np
-
 from . import ndarray as nd
-from .base import MXNetError
-from .ndarray import NDArray
 
 
 class Optimizer:
@@ -25,19 +23,19 @@ class Optimizer:
 
     @staticmethod
     def register(klass):
-        name = klass.__name__.lower()
-        if name in Optimizer.opt_registry:
-            logging.warning("New optimizer %s overriding existing one", name)
-        Optimizer.opt_registry[name] = klass
+        key = klass.__name__.lower()
+        if key in Optimizer.opt_registry:
+            logging.warning("New optimizer %s overriding existing one", key)
+        Optimizer.opt_registry[key] = klass
         return klass
 
     @staticmethod
     def create_optimizer(name, rescale_grad=1, **kwargs):
-        if name.lower() in Optimizer.opt_registry:
-            return Optimizer.opt_registry[name.lower()](
-                rescale_grad=rescale_grad, **kwargs
-            )
-        raise ValueError("Cannot find optimizer %s" % name)
+        try:
+            cls = Optimizer.opt_registry[name.lower()]
+        except KeyError:
+            raise ValueError("Cannot find optimizer %s" % name)
+        return cls(rescale_grad=rescale_grad, **kwargs)
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01,
@@ -48,20 +46,90 @@ class Optimizer:
         if lr_scheduler is not None:
             self.lr_scheduler.base_lr = learning_rate
         self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
+        self.clip_gradient = clip_gradient
         self.begin_num_update = begin_num_update
         self.num_update = begin_num_update
         self._index_update_count = {}
-        self.clip_gradient = clip_gradient
-        if param_idx2name is None:
-            param_idx2name = {}
-        assert isinstance(param_idx2name, dict)
-        self.idx2name = param_idx2name.copy()
+        assert param_idx2name is None or isinstance(param_idx2name, dict)
+        self.idx2name = dict(param_idx2name or {})
         self.sym = sym
         self.set_lr_mult({})
         self.set_wd_mult({})
 
+    # -- per-parameter hyperparameter resolution ------------------------
+    def _sym_attr_mults(self, attr_key):
+        """Collect __lr_mult__/__wd_mult__ attrs off the bound symbol."""
+        out = {}
+        if self.sym is not None:
+            attrs = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if attr_key in attrs.get(name, {}):
+                    out[name] = float(attrs[name][attr_key])
+        return out
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = self._sym_attr_mults("__lr_mult__")
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        # weight decay defaults OFF for anything that is not a weight or
+        # gamma (biases, BN betas) — the reference's convention
+        self.wd_mult = {
+            n: 0.0 for n in self.idx2name.values()
+            if not n.endswith(("_weight", "_gamma"))
+        }
+        self.wd_mult.update(self._sym_attr_mults("__wd_mult__"))
+        self.wd_mult.update(args_wd_mult)
+
+    def _mult_for(self, index, table):
+        if index in table:
+            return table[index]
+        return table.get(self.idx2name.get(index), 1.0)
+
+    def _get_lr(self, index):
+        base = (self.lr_scheduler(self.num_update)
+                if self.lr_scheduler is not None else self.lr)
+        return base * self._mult_for(index, self.lr_mult)
+
+    def _get_wd(self, index):
+        return self.wd * self._mult_for(index, self.wd_mult)
+
+    def _update_count(self, index):
+        count = self._index_update_count.get(index, self.begin_num_update) + 1
+        self._index_update_count[index] = count
+        self.num_update = max(count, self.num_update)
+
+    # -- shared eager-update pipeline -----------------------------------
+    def _begin_update(self, index, grad):
+        """Resolve (lr, wd) — BEFORE bumping the update count, so a
+        scheduler sees the pre-update count exactly like the reference —
+        then bump and return the conditioned (rescaled, clipped) grad.
+        Fused-kernel optimizers skip this: their kernels condition
+        in-op."""
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        return lr, wd, self._condition_grad(grad)
+
+    def _condition_grad(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient,
+                        a_max=self.clip_gradient)
+        return g
+
+    def _fused_kwargs(self, index):
+        """Common kwargs of the fused update kernels (lr resolved before
+        the count bump, as in _begin_update)."""
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        return {
+            "lr": lr,
+            "wd": wd,
+            "rescale_grad": self.rescale_grad,
+            "clip_gradient": self.clip_gradient or -1.0,
+        }
+
+    # -- subclass surface ----------------------------------------------
     def create_state(self, index, weight):
         return None
 
@@ -71,54 +139,13 @@ class Optimizer:
     def set_lr_scale(self, args_lrscale):  # deprecated reference API
         raise DeprecationWarning
 
-    def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
-        self.lr_mult.update(args_lr_mult)
-
-    def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            if not (n.endswith("_weight") or n.endswith("_gamma")):
-                self.wd_mult[n] = 0.0
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
-        self.wd_mult.update(args_wd_mult)
-
-    def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index], self.num_update)
-
-    def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        if index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
-
-    def _get_wd(self, index):
-        wd = self.wd
-        if index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
-
 
 register = Optimizer.register
+
+
+def _zeros_like_weight(weight, dtype=None):
+    return nd.zeros(weight.shape, ctx=weight.context,
+                    dtype=dtype or weight.dtype)
 
 
 @register
@@ -130,26 +157,15 @@ class SGD(Optimizer):
         self.momentum = momentum
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _zeros_like_weight(weight) if self.momentum else None
 
     def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        kwargs = {
-            "lr": lr,
-            "wd": wd,
-            "rescale_grad": self.rescale_grad,
-            "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
-        }
-        if state is not None:
-            nd.sgd_mom_update(
-                weight, grad, state, out=weight, momentum=self.momentum, **kwargs
-            )
-        else:
+        kwargs = self._fused_kwargs(index)
+        if state is None:
             nd.sgd_update(weight, grad, out=weight, **kwargs)
+        else:
+            nd.sgd_mom_update(weight, grad, state, out=weight,
+                              momentum=self.momentum, **kwargs)
 
 
 @register
@@ -157,38 +173,25 @@ class NAG(SGD):
     """Nesterov accelerated SGD (reference optimizer.py:413)."""
 
     def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
-        if state is not None:
-            mom = state
-            mom *= self.momentum
-            grad += wd * weight
-            mom += grad
-            grad += self.momentum * mom
-            weight += -lr * grad
-        else:
-            weight += -lr * (grad + wd * weight)
+        lr, wd, g = self._begin_update(index, grad)
+        if state is None:
+            weight[:] = weight - lr * (g + wd * weight)
+            return
+        state[:] = self.momentum * state + g + wd * weight
+        weight[:] = weight - lr * (g + wd * weight + self.momentum * state)
 
 
 @register
 class SGLD(Optimizer):
-    """Stochastic gradient Langevin dynamics (reference optimizer.py:449)."""
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:449):
+    half-step SGD plus sqrt(lr) gaussian exploration noise."""
 
     def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
         from . import random as _rnd
 
+        lr, wd, g = self._begin_update(index, grad)
         noise = _rnd.normal(0, lr ** 0.5, shape=weight.shape)
-        weight += -lr / 2 * (grad + wd * weight) + noise
+        weight[:] = weight - (lr / 2) * (g + wd * weight) + noise
 
 
 @register
@@ -198,45 +201,30 @@ class ccSGD(SGD):
 
 @register
 class DCASGD(Optimizer):
-    """Delay-compensated async SGD (reference optimizer.py:358)."""
+    """Delay-compensated async SGD (reference optimizer.py:358): corrects
+    stale gradients with lamda * g^2 * (w - w_at_gradient_time)."""
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
-        self.weight_previous = {}
         self.lamda = lamda
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return (None, weight.copy())
-        return (
-            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-            weight.copy(),
-        )
+        mom = _zeros_like_weight(weight) if self.momentum else None
+        return (mom, weight.copy())
 
     def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
-        mom, previous_weight = state
+        lr, wd, g = self._begin_update(index, grad)
+        mom, stale_weight = state
+        compensated = g + wd * weight + \
+            self.lamda * g * g * (weight - stale_weight)
         if mom is not None:
-            mom *= self.momentum
-            mom += -lr * (
-                grad
-                + wd * weight
-                + self.lamda * grad * grad * (weight - previous_weight)
-            )
+            mom[:] = self.momentum * mom - lr * compensated
+            step = mom
         else:
-            mom = -lr * (
-                grad
-                + wd * weight
-                + self.lamda * grad * grad * (weight - previous_weight)
-            )
-        previous_weight[:] = weight
-        weight += mom
+            step = -lr * compensated
+        stale_weight[:] = weight
+        weight[:] = weight + step
 
 
 @register
@@ -251,54 +239,42 @@ class Adam(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (
-            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-        )
+        return (_zeros_like_weight(weight), _zeros_like_weight(weight))
 
     def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
+        kwargs = self._fused_kwargs(index)
         t = self._index_update_count[index]
-        coef1 = 1.0 - self.beta1 ** t
-        coef2 = 1.0 - self.beta2 ** t
         # ** 0.5 (not math.sqrt) so this also traces when t/lr are jax
         # scalars inside the fused ShardedTrainStep program
-        lr *= coef2 ** 0.5 / coef1
+        bias_fix = (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        kwargs["lr"] = kwargs["lr"] * bias_fix
         mean, var = state
-        nd.adam_update(
-            weight, grad, mean, var, out=weight,
-            lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
-            epsilon=self.epsilon, rescale_grad=self.rescale_grad,
-            clip_gradient=self.clip_gradient if self.clip_gradient else -1.0,
-        )
+        nd.adam_update(weight, grad, mean, var, out=weight,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, **kwargs)
 
 
 @register
 class AdaGrad(Optimizer):
+    """Accumulated squared-gradient scaling (Duchi et al.)."""
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return nd.zeros(weight.shape, ctx=weight.context)
+        return _zeros_like_weight(weight, dtype="float32")
 
     def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
-        history = state
-        history += grad * grad
-        weight += -lr * (grad / nd.sqrt(history + self.float_stable_eps) + wd * weight)
+        lr, wd, g = self._begin_update(index, grad)
+        state += g * g
+        weight[:] = weight - lr * (
+            g / nd.sqrt(state + self.float_stable_eps) + wd * weight)
 
 
 @register
 class RMSProp(Optimizer):
-    """RMSProp (Tieleman/Hinton & Graves variants) — fused kernels."""
+    """RMSProp (Tieleman/Hinton; Graves when centered) — fused kernels."""
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
@@ -310,99 +286,79 @@ class RMSProp(Optimizer):
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        if self.centered:
-            return (
-                nd.zeros(weight.shape, ctx=weight.context),
-                nd.zeros(weight.shape, ctx=weight.context),
-                nd.zeros(weight.shape, ctx=weight.context),
-            )
-        return (nd.zeros(weight.shape, ctx=weight.context),)
+        n_slots = 3 if self.centered else 1
+        return tuple(_zeros_like_weight(weight, dtype="float32")
+                     for _ in range(n_slots))
 
     def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        kwargs = {
-            "lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
-            "gamma1": self.gamma1, "epsilon": self.epsilon,
-            "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
-            "clip_weights": self.clip_weights if self.clip_weights else -1.0,
-        }
-        if not self.centered:
-            (n,) = state
-            nd.rmsprop_update(weight, grad, n, out=weight, **kwargs)
-        else:
+        kwargs = self._fused_kwargs(index)
+        kwargs.update(gamma1=self.gamma1, epsilon=self.epsilon,
+                      clip_weights=self.clip_weights or -1.0)
+        if self.centered:
             n, g, delta = state
-            nd.rmspropalex_update(
-                weight, grad, n, g, delta, out=weight, gamma2=self.gamma2, **kwargs
-            )
+            nd.rmspropalex_update(weight, grad, n, g, delta, out=weight,
+                                  gamma2=self.gamma2, **kwargs)
+        else:
+            nd.rmsprop_update(weight, grad, state[0], out=weight, **kwargs)
 
 
 @register
 class AdaDelta(Optimizer):
+    """Adadelta (Zeiler): unit-correcting accumulated deltas, no lr."""
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho = rho
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (
-            nd.zeros(weight.shape, ctx=weight.context),
-            nd.zeros(weight.shape, ctx=weight.context),
-        )
+        return (_zeros_like_weight(weight, dtype="float32"),
+                _zeros_like_weight(weight, dtype="float32"))
 
     def update(self, index, weight, grad, state):
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        _lr, wd, g = self._begin_update(index, grad)
         acc_g, acc_delta = state
-        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * grad * grad
-        current_delta = (
-            nd.sqrt(acc_delta + self.epsilon) / nd.sqrt(acc_g + self.epsilon)
-        ) * grad
-        acc_delta[:] = self.rho * acc_delta + (1.0 - self.rho) * current_delta * current_delta
-        weight[:] = weight - current_delta - wd * weight
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * g * g
+        delta = nd.sqrt(acc_delta + self.epsilon) / \
+            nd.sqrt(acc_g + self.epsilon) * g
+        acc_delta[:] = self.rho * acc_delta + (1.0 - self.rho) * delta * delta
+        weight[:] = weight - delta - wd * weight
 
 
 @register
 class Ftrl(Optimizer):
+    """FTRL-proximal (McMahan et al.) with L1 shrinkage ``lamda1``."""
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1 = lamda1
         self.beta = beta
 
     def create_state(self, index, weight):
-        return (
-            nd.zeros(weight.shape, ctx=weight.context),  # dn
-            nd.zeros(weight.shape, ctx=weight.context),  # n
-        )
+        return (_zeros_like_weight(weight, dtype="float32"),  # z
+                _zeros_like_weight(weight, dtype="float32"))  # sum g^2
 
     def update(self, index, weight, grad, state):
+        # reference quirk kept for lr-trajectory parity: Ftrl alone bumps
+        # the update count BEFORE resolving the scheduled lr
+        # (optimizer.py:693 orders _update_count first)
         self._update_count(index)
-        wd = self._get_wd(index)
-        lr = self._get_lr(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
-        dn, n = state
-        dn += grad - (nd.sqrt(n + grad * grad) - nd.sqrt(n)) * weight / lr
-        n += grad * grad
-        weight[:] = (
-            (nd.sign(dn) * self.lamda1 - dn)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._condition_grad(grad)
+        z, n = state
+        z += g - (nd.sqrt(n + g * g) - nd.sqrt(n)) * weight / lr
+        n += g * g
+        weight[:] = (nd.sign(z) * self.lamda1 - z) * (nd.abs(z) > self.lamda1) \
             / ((self.beta + nd.sqrt(n)) / lr + wd)
-            * (nd.abs(dn) > self.lamda1)
-        )
 
 
 @register
 class Test(Optimizer):
-    """Test optimizer that does weight += rescale_grad*grad (used by the
-    reference's dist kvstore nightly tests)."""
+    """weight += rescale_grad * grad, mirroring state — the reference's
+    dist kvstore nightly-test optimizer."""
 
     def create_state(self, index, weight):
-        return nd.zeros(weight.shape, ctx=weight.context)
+        return _zeros_like_weight(weight)
 
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad
@@ -413,8 +369,9 @@ create = Optimizer.create_optimizer
 
 
 class Updater:
-    """Closure applying an optimizer to (index, grad, weight) — the local
-    update path (reference optimizer.py:761 get_updater)."""
+    """Applies one optimizer across parameters keyed by index, creating
+    state lazily — the local update path (reference get_updater); its
+    pickled states are the optimizer checkpoint payload."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer

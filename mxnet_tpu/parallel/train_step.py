@@ -279,7 +279,10 @@ class ShardedTrainStep:
         import jax
         import jax.numpy as jnp
 
+        from ..executor import _mirror_enabled, _mirror_policy
+
         program = self.program
+        do_mirror = _mirror_enabled(program)
 
         def step(params, aux, opt_state, batch, rng, lr, t):
             def loss_fn(ps):
@@ -289,6 +292,11 @@ class ShardedTrainStep:
                 # *Output heads: drive vjp with ones (Executor.backward
                 # convention — the loss op bakes its own gradient)
                 return sum(jnp.sum(o) for o in outs), (outs, new_aux)
+
+            if do_mirror:
+                # MXNET_BACKWARD_DO_MIRROR: rematerialize cheap ops in
+                # backward, keep dot/conv residuals (executor._mirror_policy)
+                loss_fn = jax.checkpoint(loss_fn, policy=_mirror_policy)
 
             grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
             # gradient allreduce over dp happens implicitly: params are

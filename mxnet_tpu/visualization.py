@@ -1,217 +1,175 @@
-"""Network visualization.
+"""Network visualization: layer summary table + graphviz plot.
 
-Parity: reference ``python/mxnet/visualization.py`` (print_summary:30 layer
-table with param counts, plot_network:167 graphviz).
+Capability parity with reference ``python/mxnet/visualization.py``
+(print_summary, plot_network), re-designed to walk the Symbol graph
+directly instead of round-tripping through graph JSON, and to count
+parameters from the actually-inferred argument shapes rather than the
+reference's per-op-type arithmetic (which under-counts anything it has
+no special case for).
 """
 from __future__ import annotations
 
-import json
-
-from .base import MXNetError
-from .symbol import Symbol
+from .symbol import Symbol, _topo_order
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Parity visualization.py:30."""
+def _walk(symbol):
+    """(nodes in topo order, head node set) for a Symbol."""
+    nodes = _topo_order([n for n, _ in symbol._outputs])
+    heads = {id(n) for n, _ in symbol._outputs}
+    return nodes, heads
+
+
+def _fmt_shape(shape):
+    return "x".join(str(d) for d in shape)
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a Keras-style table: layer, output shape, #params, inputs.
+
+    Parameter counts are exact: every variable feeding a layer (weights,
+    biases, gammas...) contributes its inferred size."""
     if not isinstance(symbol, Symbol):
         raise TypeError("symbol must be Symbol")
-    show_shape = False
+    out_shapes = {}
+    arg_shapes = {}
     if shape is not None:
-        show_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        if out_shapes is None:
+        internals = symbol.get_internals()
+        _, shapes, _ = internals.infer_shape(**shape)
+        if shapes is None:
             raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    heads = set(conf["heads"][0])
-    if positions[-1] <= 1:
-        positions = [int(line_length * p) for p in positions]
-    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+        out_shapes = dict(zip(internals.list_outputs(), shapes))
+        arg_shapes = dict(zip(symbol.list_arguments(),
+                              symbol.infer_shape(**shape)[0]))
 
-    def print_row(fields, positions):
+    cols = [int(line_length * p) if p <= 1 else p for p in positions]
+
+    def emit(fields):
         line = ""
-        for i, field in enumerate(fields):
-            line += str(field)
-            line = line[: positions[i]]
-            line += " " * (positions[i] - len(line))
+        for width, field in zip(cols, fields):
+            line = (line + str(field))[:width].ljust(width)
         print(line)
 
+    nodes, heads = _walk(symbol)
+    first = nodes[0] if nodes else None
+    # inputs (given shapes, labels) are fed, not learned
+    non_params = set(shape or ()) | {
+        n for n in arg_shapes if n.endswith("label")}
+
     print("_" * line_length)
-    print_row(to_display, positions)
+    emit(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
     print("=" * line_length)
 
-    def print_layer_summary(node, out_shape):
-        op = node["op"]
-        pre_node = []
-        pre_filter = 0
-        if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-                    if show_shape:
-                        key = input_name
-                        if input_node["op"] != "null":
-                            key += "_output"
-                        if key in shape_dict:
-                            shape = shape_dict[key][1:]
-                            pre_filter = pre_filter + int(shape[0])
-        cur_param = 0
-        attrs = node.get("attr", {})
-        if op == "Convolution":
-            from .base import parse_attr_value
-
-            kernel = parse_attr_value(attrs.get("kernel", "(1,1)"))
-            num_filter = int(attrs.get("num_filter", 1))
-            cur_param = pre_filter * num_filter
-            for k in kernel:
-                cur_param *= k
-            cur_param += num_filter
-        elif op == "FullyConnected":
-            nh = int(attrs.get("num_hidden", 0))
-            cur_param = nh * (pre_filter + 1)
-        elif op == "BatchNorm":
-            key = node["name"] + "_output"
-            if show_shape and key in shape_dict:
-                num_filter = shape_dict[key][1]
-                cur_param = int(num_filter) * 2
-        if not pre_node:
-            first_connection = ""
+    total = 0
+    rows = []
+    for node in nodes:
+        if node.is_variable and node is not first and id(node) not in heads:
+            continue  # parameters are counted into their layer's row
+        if node.is_variable:
+            op_name = "null"
+            prev = []
+            n_params = 0
         else:
-            first_connection = pre_node[0]
-        fields = [
-            node["name"] + "(" + op + ")",
-            "x".join([str(x) for x in out_shape]),
-            cur_param,
-            first_connection,
-        ]
-        print_row(fields, positions)
-        if len(pre_node) > 1:
-            for i in range(1, len(pre_node)):
-                fields = ["", "", "", pre_node[i]]
-                print_row(fields, positions)
-        return cur_param
+            op_name = node.op.name
+            prev = [c.name for (c, _i) in node.inputs
+                    if not c.is_variable or id(c) in heads]
+            # exact: sum the sizes of this node's parameter variables
+            n_params = 0
+            for (c, _i) in node.inputs:
+                if c.is_variable and c.name in arg_shapes and \
+                        c.name not in non_params:
+                    s = arg_shapes[c.name]
+                    size = 1
+                    for d in s:
+                        size *= int(d)
+                    n_params += size
+        key = node.name if node.is_variable else node.name + "_output"
+        oshape = out_shapes.get(key, ())
+        oshape = oshape[1:] if oshape else []
+        rows.append((node, op_name, oshape, n_params, prev))
+        total += n_params
 
-    total_params = 0
-    for i, node in enumerate(nodes):
-        out_shape = []
-        op = node["op"]
-        if op == "null" and i > 0:
-            continue
-        if op != "null" or i in heads:
-            if show_shape:
-                key = node["name"] + ("_output" if op != "null" else "")
-                if key in shape_dict:
-                    out_shape = shape_dict[key][1:]
-        total_params += print_layer_summary(node, out_shape)
-        if i == len(nodes) - 1:
-            print("=" * line_length)
-        else:
-            print("_" * line_length)
-    print("Total params: %s" % total_params)
+    for i, (node, op_name, oshape, n_params, prev) in enumerate(rows):
+        emit(["%s(%s)" % (node.name, op_name), _fmt_shape(oshape),
+              n_params, prev[0] if prev else ""])
+        for extra in prev[1:]:
+            emit(["", "", "", extra])
+        print(("=" if i == len(rows) - 1 else "_") * line_length)
+    print("Total params: %s" % total)
     print("_" * line_length)
+    return total
+
+
+_PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta",
+                   "_moving_var", "_moving_mean")
+
+# fillcolor + label builder per op family (colorbrewer Set3)
+_STYLE = {
+    "Convolution": ("#fb8072", lambda a: "Convolution\n%s/%s, %s" % (
+        a.get("kernel", ""), a.get("stride", "1"), a.get("num_filter", ""))),
+    "FullyConnected": ("#fb8072", lambda a: "FullyConnected\n%s"
+                       % a.get("num_hidden", "")),
+    "Activation": ("#ffffb3", lambda a: "Activation\n%s"
+                   % a.get("act_type", "")),
+    "LeakyReLU": ("#ffffb3", lambda a: "LeakyReLU\n%s"
+                  % a.get("act_type", "")),
+    "BatchNorm": ("#bebada", None),
+    "Pooling": ("#80b1d3", lambda a: "Pooling\n%s, %s/%s" % (
+        a.get("pool_type", ""), a.get("kernel", ""), a.get("stride", "1"))),
+    "Concat": ("#fdb462", None),
+    "Flatten": ("#fdb462", None),
+    "Reshape": ("#fdb462", None),
+    "Softmax": ("#b3de69", None),
+}
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
-                 node_attrs={}, hide_weights=True):
-    """Parity visualization.py:167 — returns a graphviz Digraph (or raises
-    if graphviz is unavailable)."""
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the network (raises without graphviz)."""
     try:
         from graphviz import Digraph
     except ImportError as e:
         raise ImportError("Draw network requires graphviz library") from e
     if not isinstance(symbol, Symbol):
         raise TypeError("symbol must be a Symbol")
-    draw_shape = False
-    shape_dict = {}
+
+    out_shapes = {}
     if shape is not None:
-        draw_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    node_attr = {
-        "shape": "box", "fixedsize": "true", "width": "1.3", "height": "0.8034",
-        "style": "filled",
-    }
-    node_attr.update(node_attrs)
+        internals = symbol.get_internals()
+        _, shapes, _ = internals.infer_shape(**shape)
+        out_shapes = dict(zip(internals.list_outputs(), shapes))
+
+    base_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    base_attr.update(node_attrs or {})
     dot = Digraph(name=title, format=save_format)
-    cm = (
-        "#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3", "#fdb462",
-        "#b3de69", "#fccde5",
-    )
 
-    def looks_like_weight(name):
-        if name.endswith("_weight") or name.endswith("_bias") or \
-                name.endswith("_gamma") or name.endswith("_beta") or \
-                name.endswith("_moving_var") or name.endswith("_moving_mean"):
-            return True
-        return False
-
-    hidden_nodes = set()
+    nodes, _heads = _walk(symbol)
+    hidden = set()
     for node in nodes:
-        op = node["op"]
-        name = node["name"]
-        attr = node_attr.copy()
-        label = name
-        if op == "null":
-            if looks_like_weight(name):
-                if hide_weights:
-                    hidden_nodes.add(name)
+        attr = dict(base_attr)
+        if node.is_variable:
+            if hide_weights and node.name.endswith(_PARAM_SUFFIXES):
+                hidden.add(node.name)
                 continue
-            attr["shape"] = "oval"
-            label = name
-            attr["fillcolor"] = cm[0]
-        elif op == "Convolution":
-            a = node.get("attr", {})
-            label = "Convolution\n%s/%s, %s" % (
-                a.get("kernel", ""), a.get("stride", "1"), a.get("num_filter", "")
-            )
-            attr["fillcolor"] = cm[1]
-        elif op == "FullyConnected":
-            label = "FullyConnected\n%s" % node.get("attr", {}).get("num_hidden", "")
-            attr["fillcolor"] = cm[1]
-        elif op == "BatchNorm":
-            attr["fillcolor"] = cm[3]
-        elif op == "Activation" or op == "LeakyReLU":
-            label = "%s\n%s" % (op, node.get("attr", {}).get("act_type", ""))
-            attr["fillcolor"] = cm[2]
-        elif op == "Pooling":
-            a = node.get("attr", {})
-            label = "Pooling\n%s, %s/%s" % (
-                a.get("pool_type", ""), a.get("kernel", ""), a.get("stride", "1")
-            )
-            attr["fillcolor"] = cm[4]
-        elif op in ("Concat", "Flatten", "Reshape"):
-            attr["fillcolor"] = cm[5]
-        elif op == "Softmax":
-            attr["fillcolor"] = cm[6]
-        else:
-            attr["fillcolor"] = cm[7]
-        dot.node(name=name, label=label, **attr)
-    for node in nodes:
-        op = node["op"]
-        name = node["name"]
-        if op == "null":
+            attr.update(shape="oval", fillcolor="#8dd3c7")
+            dot.node(name=node.name, label=node.name, **attr)
             continue
-        inputs = node["inputs"]
-        for item in inputs:
-            input_node = nodes[item[0]]
-            input_name = input_node["name"]
-            if input_name not in hidden_nodes:
-                attr = {"dir": "back", "arrowtail": "open"}
-                if draw_shape:
-                    key = input_name
-                    if input_node["op"] != "null":
-                        key += "_output"
-                    if key in shape_dict:
-                        shape = shape_dict[key][1:]
-                        label = "x".join([str(x) for x in shape])
-                        attr["label"] = label
-                dot.edge(tail_name=name, head_name=input_name, **attr)
+        color, labeler = _STYLE.get(node.op.name, ("#fccde5", None))
+        attrs = {k: str(v) for k, v in node.attrs.items()}
+        label = labeler(attrs) if labeler else node.name
+        attr["fillcolor"] = color
+        dot.node(name=node.name, label=label, **attr)
+
+    for node in nodes:
+        if node.is_variable:
+            continue
+        for (src, _i) in node.inputs:
+            if src.name in hidden:
+                continue
+            edge = {"dir": "back", "arrowtail": "open"}
+            key = src.name if src.is_variable else src.name + "_output"
+            if key in out_shapes:
+                edge["label"] = _fmt_shape(out_shapes[key][1:])
+            dot.edge(tail_name=node.name, head_name=src.name, **edge)
     return dot

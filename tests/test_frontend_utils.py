@@ -1,0 +1,148 @@
+"""Callbacks, LR schedulers, Monitor, and print_summary — behavior pins
+for the round-3 rewrites of the frontend utility tier (these files'
+semantics come from reference python/mxnet/{callback,lr_scheduler,
+monitor,visualization}.py; the values asserted here were computed
+independently from those semantics)."""
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+# -- lr schedulers ----------------------------------------------------------
+
+def test_factor_scheduler_closed_form():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    # reference while-loop semantics: decay fires when num_update
+    # crosses count+step, i.e. lr halves at updates 11, 21, 31...
+    assert s(1) == 1.0
+    assert s(10) == 1.0
+    assert s(11) == 0.5
+    assert s(20) == 0.5
+    assert s(21) == 0.25
+    # idempotent: re-evaluating an old update count gives the same lr
+    assert s(11) == 0.5
+
+
+def test_factor_scheduler_stop_floor():
+    s = mx.lr_scheduler.FactorScheduler(step=1, factor=0.1,
+                                        stop_factor_lr=1e-3)
+    s.base_lr = 1.0
+    assert abs(s(2) - 0.1) < 1e-12
+    assert s(100) == 1e-3  # floored
+
+
+def test_multi_factor_scheduler():
+    s = mx.lr_scheduler.MultiFactorScheduler(step=[5, 8], factor=0.1)
+    s.base_lr = 2.0
+    assert s(5) == 2.0
+    assert abs(s(6) - 0.2) < 1e-12
+    assert abs(s(8) - 0.2) < 1e-12
+    assert abs(s(9) - 0.02) < 1e-12
+    import pytest
+
+    with pytest.raises(ValueError):
+        mx.lr_scheduler.MultiFactorScheduler(step=[8, 5])
+
+
+def test_scheduler_in_optimizer():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           lr_scheduler=mx.lr_scheduler.FactorScheduler(
+                               step=2, factor=0.5))
+    assert opt.lr_scheduler.base_lr == 1.0
+
+
+# -- callbacks --------------------------------------------------------------
+
+def test_speedometer_logs_and_resets_metric(caplog):
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0.0])], [mx.nd.array([[0.9, 0.1]])])
+    speedo = mx.callback.Speedometer(batch_size=4, frequent=2)
+    with caplog.at_level(logging.INFO):
+        speedo(BatchEndParam(0, 0, metric, None))   # arms the timer
+        speedo(BatchEndParam(0, 1, metric, None))   # off-period
+        speedo(BatchEndParam(0, 2, metric, None))   # logs + resets
+    assert any("samples/sec" in r.message for r in caplog.records)
+    assert metric.num_inst == 0  # reset happened
+    # epoch rollover re-arms without logging
+    caplog.clear()
+    with caplog.at_level(logging.INFO):
+        speedo(BatchEndParam(1, 0, metric, None))
+    assert not any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_log_train_metric(caplog):
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0.0])], [mx.nd.array([[0.9, 0.1]])])
+    cb = mx.callback.log_train_metric(2, auto_reset=True)
+    with caplog.at_level(logging.INFO):
+        cb(BatchEndParam(0, 2, metric, None))
+    assert any("Train-accuracy" in r.message for r in caplog.records)
+    assert metric.num_inst == 0
+
+
+def test_do_checkpoint_period(tmp_path):
+    fired = []
+
+    cb = mx.callback.module_checkpoint(
+        type("M", (), {"save_checkpoint":
+                       staticmethod(lambda p, e, s: fired.append(e))})(),
+        str(tmp_path / "x"), period=2)
+    for epoch_idx in range(4):
+        cb(epoch_idx)
+    assert fired == [2, 4]
+
+
+# -- monitor ----------------------------------------------------------------
+
+def test_monitor_collects_matching_stats():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    exe = net.simple_bind(ctx=mx.cpu(0), data=(2, 3))
+    rng = np.random.RandomState(0)
+    exe.arg_dict["fc1_weight"][:] = rng.randn(4, 3)
+    exe.arg_dict["fc1_bias"][:] = 0
+    exe.arg_dict["data"][:] = rng.randn(2, 3)
+
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc1.*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False)
+    records = mon.toc()
+    names = [name for _step, name, _v in records]
+    assert any("fc1" in n for n in names)
+    assert not any("relu" in n for n in names)
+    # interval gating: second tic on step 1 with interval 2 stays dark
+    mon2 = mx.monitor.Monitor(interval=2, pattern=".*")
+    mon2.install(exe)
+    mon2.tic()
+    assert mon2.activated
+    mon2.toc()
+    mon2.tic()
+    assert not mon2.activated
+
+
+# -- visualization ----------------------------------------------------------
+
+def test_print_summary_exact_param_counts(capsys):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    total = mx.viz.print_summary(net, shape={"data": (1, 3, 8, 8)})
+    # conv 3*8*3*3+8 = 224; bn gamma+beta = 16; fc 512*10+10 = 5130
+    assert total == 224 + 16 + 5130
+    out = capsys.readouterr().out
+    assert "conv1(Convolution)" in out
+    assert "Total params: 5370" in out

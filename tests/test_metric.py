@@ -1,0 +1,127 @@
+"""Metric semantics pinned against hand-computed values (reference
+python/mxnet/metric.py behavior; tests/python/unittest has no dedicated
+metric suite — these pin the parity surface directly)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+def test_accuracy_probs_and_ids():
+    m = mx.metric.Accuracy()
+    probs = [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]]  # argmax: 1, 0, 1
+    m.update([_nd([1, 1, 1])], [_nd(probs)])
+    assert m.get() == ("accuracy", 2.0 / 3.0)
+    m.reset()
+    m.update([_nd([0, 1])], [_nd([0, 0])])  # already class ids
+    assert m.get() == ("accuracy", 0.5)
+
+
+def test_top_k_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    assert m.name == "top_k_accuracy_2"
+    probs = [[0.5, 0.3, 0.2],   # top2 = {0,1}
+             [0.1, 0.2, 0.7],   # top2 = {1,2}
+             [0.3, 0.45, 0.25]]  # top2 = {0,1}
+    m.update([_nd([1, 0, 2])], [_nd(probs)])
+    assert m.get() == ("top_k_accuracy_2", 1.0 / 3.0)
+    with pytest.raises(AssertionError):
+        mx.metric.TopKAccuracy(top_k=1)
+
+
+def test_f1_binary():
+    m = mx.metric.F1()
+    # preds: 1,1,0,0 ; labels: 1,0,1,0 -> tp=1 fp=1 fn=1 -> P=R=0.5, f1=0.5
+    probs = [[0.2, 0.8], [0.3, 0.7], [0.9, 0.1], [0.6, 0.4]]
+    m.update([_nd([1, 0, 1, 0])], [_nd(probs)])
+    assert m.get() == ("f1", 0.5)
+    with pytest.raises(ValueError):
+        m.update([_nd([0, 1, 2])], [_nd([[1, 0, 0]] * 3)])
+
+
+def test_perplexity_with_ignore():
+    m = mx.metric.Perplexity(ignore_label=0)
+    probs = [[0.0, 0.5, 0.5], [0.0, 0.25, 0.75], [1.0, 0.0, 0.0]]
+    labels = [1, 2, 0]  # last token ignored
+    m.update([_nd(labels)], [_nd(probs)])
+    expect = math.exp(-(math.log(0.5) + math.log(0.75)) / 2)
+    assert abs(m.get()[1] - expect) < 1e-6
+
+
+def test_regression_metrics():
+    label = [1.0, 2.0, 3.0]
+    pred = [[1.5], [2.0], [2.0]]  # errors 0.5, 0, 1
+    mae = mx.metric.MAE()
+    mae.update([_nd(label)], [_nd(pred)])
+    assert abs(mae.get()[1] - 0.5) < 1e-6
+    mse = mx.metric.MSE()
+    mse.update([_nd(label)], [_nd(pred)])
+    assert abs(mse.get()[1] - (0.25 + 0 + 1) / 3) < 1e-6
+    rmse = mx.metric.RMSE()
+    rmse.update([_nd(label)], [_nd(pred)])
+    assert abs(rmse.get()[1] - math.sqrt((0.25 + 0 + 1) / 3)) < 1e-6
+
+
+def test_cross_entropy():
+    m = mx.metric.CrossEntropy(eps=0.0)
+    probs = [[0.25, 0.75], [0.5, 0.5]]
+    m.update([_nd([1, 0])], [_nd(probs)])
+    expect = (-math.log(0.75) - math.log(0.5)) / 2
+    assert abs(m.get()[1] - expect) < 1e-6
+
+
+def test_custom_and_np_wrapper():
+    def my_mean_error(label, pred):
+        return float(np.abs(label - pred.ravel()).sum()), label.size
+
+    m = mx.metric.np(my_mean_error)
+    m.update([_nd([1.0, 2.0])], [_nd([[2.0], [2.0]])])
+    assert m.get() == ("my_mean_error", 0.5)
+
+    m2 = mx.metric.create(lambda l, p: 1.25)
+    m2.update([_nd([0.0])], [_nd([[0.0]])])
+    assert m2.get()[1] == 1.25
+
+
+def test_composite_and_create():
+    comp = mx.metric.create(["acc", "ce"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+    probs = [[0.2, 0.8], [0.9, 0.1]]
+    comp.update([_nd([1, 0])], [_nd(probs)])
+    names, values = comp.get()
+    assert names == ["accuracy", "cross-entropy"]
+    assert values[0] == 1.0
+    pairs = dict(comp.get_name_value())
+    assert set(pairs) == {"accuracy", "cross-entropy"}
+    with pytest.raises(ValueError):
+        mx.metric.create("not_a_metric")
+
+
+def test_running_average_and_reset():
+    m = mx.metric.Accuracy()
+    m.update([_nd([0])], [_nd([[0.9, 0.1]])])  # hit
+    m.update([_nd([1])], [_nd([[0.9, 0.1]])])  # miss
+    assert m.get()[1] == 0.5
+    m.reset()
+    assert math.isnan(m.get()[1])
+
+
+def test_multi_slot_metric():
+    class TwoHead(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("head", num=2)
+
+        def _score(self, label, pred):
+            return float(np.abs(label - pred).sum()), label.size
+
+    m = TwoHead()
+    m.update([_nd([1.0]), _nd([0.0])], [_nd([0.0]), _nd([0.0])])
+    names, values = m.get()
+    assert names == ["head_0", "head_1"]
+    assert values == [1.0, 0.0]

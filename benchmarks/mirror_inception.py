@@ -57,7 +57,7 @@ def build_step(mirror, batch):
 
     from mxnet_tpu.executor import _mirror_enabled, _mirror_policy
 
-    do_mirror = _mirror_enabled(program)
+    do_mirror = _mirror_enabled()
     assert do_mirror == mirror
 
     def train_step(params, aux, data, label):

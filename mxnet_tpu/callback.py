@@ -63,24 +63,30 @@ def log_train_metric(period, auto_reset=False):
 
 
 class _Throughput:
-    """Samples/sec over a window; restarts cleanly on epoch rollover."""
+    """Samples/sec sampled every ``frequent`` batches; owns ALL window
+    state, including epoch-rollover restarts."""
 
-    def __init__(self, batch_size):
+    def __init__(self, batch_size, frequent):
         self.batch_size = batch_size
+        self.frequent = frequent
         self._since = None
         self._last_batch = 0
 
-    def rate(self, nbatch):
-        """None until a full window has elapsed, else samples/sec."""
-        now = time.time()
-        if self._since is None or nbatch < self._last_batch:
-            self._since = now
-            self._last_batch = nbatch
+    def sample(self, nbatch):
+        """samples/sec when a full window just closed at nbatch, else
+        None (off-period batch, first window still filling, or an epoch
+        rollover that restarts the window)."""
+        rolled = nbatch < self._last_batch
+        if not rolled and nbatch % self.frequent != 0:
             return None
-        elapsed = max(now - self._since, 1e-12)
+        now = time.time()
+        armed = self._since is not None
+        elapsed = max(now - (self._since or now), 1e-12)
         n_batches = nbatch - self._last_batch
         self._since = now
         self._last_batch = nbatch
+        if rolled or not armed:
+            return None
         return n_batches * self.batch_size / elapsed
 
 
@@ -91,13 +97,11 @@ class Speedometer(object):
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self._meter = _Throughput(batch_size)
+        self._meter = _Throughput(batch_size, frequent)
 
     def __call__(self, param):
         nbatch = param.nbatch
-        if nbatch % self.frequent != 0 and nbatch >= self._meter._last_batch:
-            return
-        speed = self._meter.rate(nbatch)
+        speed = self._meter.sample(nbatch)
         if speed is None:
             return
         if param.eval_metric is not None:

@@ -47,12 +47,12 @@ def _ctx_group(node):
     return node.attrs.get("ctx_group") or node.attrs.get("__ctx_group__")
 
 
-def _mirror_enabled(program):
+def _mirror_enabled():
     """Whole-graph gradient-checkpoint switch: the env flag only
-    (reference MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:213-226).
-    Per-node __force_mirroring__ attrs remat just their own node — see
-    _compute_node — so one flagged activation doesn't silently escalate
-    to whole-model recompute."""
+    (reference MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:213-226) —
+    process-wide, not per-graph. Per-node __force_mirroring__ attrs remat
+    just their own node — see _compute_node — so one flagged activation
+    doesn't silently escalate to whole-model recompute."""
     from .base import get_env
 
     return bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0))
@@ -525,7 +525,7 @@ class Executor:
         aux_names = tuple(self._aux_names)
         grad_names = tuple(self._grad_names)
 
-        do_mirror = _mirror_enabled(program)
+        do_mirror = _mirror_enabled()
 
         @jax.jit
         def fwdbwd(arg_vals, aux_vals, rng, out_grads):

@@ -293,14 +293,27 @@ def _deconvolution(attrs, ins, is_train):
     data, weight = ins[0], ins[1]
     # Transposed conv = gradient of conv wrt its input: lhs-dilated conv with
     # flipped kernel (weight layout (C_in, C_out/g, *K) as in the reference).
-    out = jax.lax.conv_transpose(
+    # Expressed directly as the transpose of a strided conv: an
+    # lhs-dilated conv_general_dilated with the spatially-flipped,
+    # in/out-swapped kernel. (lax.conv_transpose lacks group support and
+    # its transpose_kernel path fails to differentiate in current jax.)
+    c_in = weight.shape[0]
+    c_out_g = weight.shape[1]
+    # (C_in, C_out/g, *K) -> (C_out, C_in/g, *K)
+    w = weight.reshape((groups, c_in // groups, c_out_g) + kernel)
+    w = jnp.swapaxes(w, 1, 2).reshape(
+        (groups * c_out_g, c_in // groups) + kernel)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    k_eff = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    out = jax.lax.conv_general_dilated(
         data,
-        weight,
-        strides=stride,
-        padding=[(p, p - a) for p, a in zip(pad, adj)],
+        w,
+        window_strides=(1,) * nd,
+        padding=[(ke - 1 - p, ke - 1 - p + a)
+                 for ke, p, a in zip(k_eff, pad, adj)],
+        lhs_dilation=stride,
         rhs_dilation=dilate,
         dimension_numbers=_conv_dn(nd),
-        transpose_kernel=True,
         feature_group_count=groups,
     )
     if not bool(attrs.get("no_bias", True)):

@@ -282,7 +282,7 @@ class ShardedTrainStep:
         from ..executor import _mirror_enabled, _mirror_policy
 
         program = self.program
-        do_mirror = _mirror_enabled(program)
+        do_mirror = _mirror_enabled()
 
         def step(params, aux, opt_state, batch, rng, lr, t):
             def loss_fn(ps):

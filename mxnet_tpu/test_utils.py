@@ -165,6 +165,11 @@ def numeric_grad(executor, location, aux_states=None, eps=1e-4,
             approx_grads[k].ravel()[i] = (f_peps - f_neps).sum() / eps
             v.ravel()[i] = old_value.ravel()[i]
         location[k] = old_value
+        # restore the executor's copy too: the loop's last write left the
+        # final element at -eps/2, which silently perturbs every LATER
+        # key's finite differences (fatal for integer-cast inputs like
+        # embedding indices, where int(1 - eps/2) == 0)
+        executor.arg_dict[k][:] = old_value
     return approx_grads
 
 

@@ -1,0 +1,248 @@
+"""Auto-parametrized finite-difference gradient sweep.
+
+Reference model: tests/python/unittest/test_operator.py's
+check_numeric_gradient usage (harness: python/mxnet/test_utils.py:300-538
+— central differences on a random projection of the output vs the
+symbolic backward). One pytest case per (op, domain) row; domains keep
+inputs away from non-differentiable points (kinks, branch cuts, ties)
+so the FD estimate is meaningful.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+_rng = np.random.RandomState(7)
+S = (3, 4)
+
+
+def _dom(kind, shape=S):
+    """Random inputs constrained to a differentiable-friendly domain."""
+    if kind == "pos":          # log/sqrt/rsqrt/prod...
+        return _rng.uniform(0.5, 2.0, shape).astype(np.float32)
+    if kind == "unit":         # arcsin/arccos/arctanh
+        return _rng.uniform(-0.8, 0.8, shape).astype(np.float32)
+    if kind == "gt1":          # arccosh
+        return _rng.uniform(1.5, 3.0, shape).astype(np.float32)
+    if kind == "off0":         # abs/relu: stay off the kink at 0
+        x = _rng.uniform(0.3, 1.5, shape).astype(np.float32)
+        return x * np.where(_rng.rand(*shape) < 0.5, -1, 1).astype(np.float32)
+    if kind == "spread":       # max/min/maximum: no ties
+        flat = np.linspace(-2.0, 2.0, int(np.prod(shape)), dtype=np.float32)
+        return _rng.permutation(flat).reshape(shape)
+    return _rng.uniform(-2.0, 2.0, shape).astype(np.float32)
+
+
+def _unary(op, dom="any", attrs=None, rtol=0.05, atol=1e-3):
+    def build():
+        data = mx.sym.Variable("data")
+        return getattr(mx.sym, op)(data, **(attrs or {})), \
+            {"data": _dom(dom)}
+    return pytest.param(build, rtol, atol, id=op)
+
+
+def _binary(op, dom_a="any", dom_b="any", rtol=0.05, atol=1e-3, attrs=None,
+            shape_b=S, ident=None):
+    def build():
+        a = mx.sym.Variable("a")
+        b = mx.sym.Variable("b")
+        return getattr(mx.sym, op)(a, b, **(attrs or {})), \
+            {"a": _dom(dom_a), "b": _dom(dom_b, shape_b)}
+    return pytest.param(build, rtol, atol, id=ident or op)
+
+
+def _case(ident, builder, rtol=0.05, atol=1e-3):
+    return pytest.param(builder, rtol, atol, id=ident)
+
+
+CASES = [
+    # ---- elementwise unary ------------------------------------------------
+    _unary("exp"), _unary("log", "pos"), _unary("log10", "pos"),
+    _unary("log2", "pos"), _unary("log1p", "pos"), _unary("expm1"),
+    _unary("sqrt", "pos"), _unary("rsqrt", "pos"), _unary("cbrt", "pos"),
+    _unary("square"), _unary("abs", "off0"), _unary("negative"),
+    _unary("reciprocal", "pos"),
+    _unary("sin"), _unary("cos"), _unary("tan", "unit"),
+    _unary("arcsin", "unit"), _unary("arccos", "unit"), _unary("arctan"),
+    _unary("sinh", "unit"), _unary("cosh", "unit"), _unary("tanh"),
+    _unary("arcsinh"), _unary("arccosh", "gt1"), _unary("arctanh", "unit"),
+    _unary("sigmoid"), _unary("relu", "off0"),
+    _case("softrelu", lambda: (
+        mx.sym.Activation(mx.sym.Variable("data"), act_type="softrelu"),
+        {"data": _dom("any")})),
+    _unary("degrees"), _unary("radians"),
+    _unary("gamma", "pos"), _unary("gammaln", "pos"),
+    # ---- elementwise binary / broadcast ------------------------------------
+    _binary("elemwise_add", ident="elemwise_add"),
+    _binary("elemwise_sub", ident="elemwise_sub"),
+    _binary("elemwise_mul", ident="elemwise_mul"),
+    _binary("elemwise_div", dom_b="pos", ident="elemwise_div"),
+    _binary("broadcast_add", shape_b=(1, 4)),
+    _binary("broadcast_sub", shape_b=(3, 1)),
+    _binary("broadcast_mul", shape_b=(1, 4)),
+    _binary("broadcast_div", dom_b="pos", shape_b=(1, 4)),
+    _binary("broadcast_power", dom_a="pos", shape_b=(1, 4)),
+    _binary("broadcast_maximum", dom_a="spread", dom_b="pos",
+            shape_b=(1, 4)),
+    _binary("broadcast_minimum", dom_a="spread", dom_b="pos",
+            shape_b=(1, 4)),
+    _case("hypot", lambda: (
+        getattr(mx.sym, "_hypot")(mx.sym.Variable("a"),
+                                  mx.sym.Variable("b")),
+        {"a": _dom("pos"), "b": _dom("pos")})),
+    _case("smooth_l1", lambda: (
+        mx.sym.smooth_l1(mx.sym.Variable("data"), scalar=1.0),
+        {"data": _dom("off0")})),
+    # ---- scalar variants ----------------------------------------------------
+    _unary("__add_scalar", attrs=None) if False else
+    _case("plus_scalar", lambda: (
+        mx.sym.Variable("data") + 1.5, {"data": _dom("any")})),
+    _case("rminus_scalar", lambda: (
+        2.0 - mx.sym.Variable("data"), {"data": _dom("any")})),
+    _case("mul_scalar", lambda: (
+        mx.sym.Variable("data") * 0.7, {"data": _dom("any")})),
+    _case("rdiv_scalar", lambda: (
+        1.0 / mx.sym.Variable("data"), {"data": _dom("pos")})),
+    _case("pow_scalar", lambda: (
+        mx.sym.Variable("data") ** 2.0, {"data": _dom("pos")})),
+    # ---- reductions ----------------------------------------------------------
+    _unary("sum", "any", {"axis": 1}),
+    _unary("mean", "any", {"axis": 0}),
+    _unary("max", "spread", {"axis": 1}),
+    _unary("min", "spread", {"axis": 1}),
+    _unary("prod", "pos", {"axis": 1}),
+    _unary("nansum", "any", {"axis": 1}),
+    _unary("norm"),
+    # ---- shape / movement ----------------------------------------------------
+    _unary("transpose"),
+    _unary("Flatten"),
+    _unary("expand_dims", "any", {"axis": 1}),
+    _case("reshape", lambda: (
+        mx.sym.Reshape(mx.sym.Variable("data"), shape=(4, 3)),
+        {"data": _dom("any")})),
+    _case("slice", lambda: (
+        mx.sym.slice(mx.sym.Variable("data"), begin=(1, 0), end=(3, 3)),
+        {"data": _dom("any")})),
+    _case("slice_axis", lambda: (
+        mx.sym.slice_axis(mx.sym.Variable("data"), axis=1, begin=1, end=3),
+        {"data": _dom("any")})),
+    _case("clip", lambda: (
+        mx.sym.clip(mx.sym.Variable("data"), a_min=-1.0, a_max=1.0),
+        {"data": _dom("spread") * 0.6})),
+    _case("repeat", lambda: (
+        mx.sym.repeat(mx.sym.Variable("data"), repeats=2, axis=1),
+        {"data": _dom("any")})),
+    _case("tile", lambda: (
+        mx.sym.tile(mx.sym.Variable("data"), reps=(2, 1)),
+        {"data": _dom("any")})),
+    _case("reverse", lambda: (
+        mx.sym.reverse(mx.sym.Variable("data"), axis=1),
+        {"data": _dom("any")})),
+    _case("concat", lambda: (
+        mx.sym.Concat(mx.sym.Variable("a"), mx.sym.Variable("b"), dim=1),
+        {"a": _dom("any"), "b": _dom("any")})),
+    _case("SliceChannel", lambda: (
+        mx.sym.SliceChannel(mx.sym.Variable("data"), num_outputs=2,
+                            axis=1)[0],
+        {"data": _dom("any", (3, 4))})),
+    _unary("SwapAxis", "any", {"dim1": 0, "dim2": 1}),
+    _case("pad", lambda: (
+        mx.sym.Pad(mx.sym.Variable("data"), mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+        {"data": _dom("any", (2, 2, 3, 3))})),
+    _case("where", lambda: (
+        mx.sym.where(mx.sym.Variable("c"), mx.sym.Variable("a"),
+                     mx.sym.Variable("b")),
+        {"c": (_rng.rand(*S) < 0.5).astype(np.float32),
+         "a": _dom("any"), "b": _dom("any")},
+        ["a", "b"])),
+    # ---- linear algebra -------------------------------------------------------
+    _case("dot", lambda: (
+        mx.sym.dot(mx.sym.Variable("a"), mx.sym.Variable("b")),
+        {"a": _dom("any", (3, 4)), "b": _dom("any", (4, 2))})),
+    _case("batch_dot", lambda: (
+        mx.sym.batch_dot(mx.sym.Variable("a"), mx.sym.Variable("b")),
+        {"a": _dom("any", (2, 3, 4)), "b": _dom("any", (2, 4, 2))})),
+    # ---- indexing --------------------------------------------------------------
+    _case("take", lambda: (
+        mx.sym.take(mx.sym.Variable("a"), mx.sym.Variable("idx")),
+        {"a": _dom("any", (5, 4)),
+         "idx": np.array([0, 2, 4], np.float32)}, ["a"])),
+    _case("Embedding", lambda: (
+        mx.sym.Embedding(mx.sym.Variable("idx"),
+                         mx.sym.Variable("w"),
+                         input_dim=6, output_dim=3),
+        {"idx": np.array([[0, 2], [5, 1]], np.float32),
+         "w": _dom("any", (6, 3))}, ["w"])),
+    _case("pick", lambda: (
+        mx.sym.pick(mx.sym.Variable("a"), mx.sym.Variable("idx"), axis=1),
+        {"a": _dom("any"), "idx": np.array([0, 3, 1], np.float32)},
+        ["a"])),
+    # ---- softmax family ----------------------------------------------------------
+    _unary("softmax", "any", {"axis": -1}),
+    _unary("log_softmax", "any", {"axis": -1}),
+    _unary("SoftmaxActivation"),
+    # ---- nn layers ------------------------------------------------------------------
+    _case("FullyConnected", lambda: (
+        mx.sym.FullyConnected(mx.sym.Variable("data"),
+                              mx.sym.Variable("w"), mx.sym.Variable("b"),
+                              num_hidden=3),
+        {"data": _dom("any", (2, 5)), "w": _dom("any", (3, 5)),
+         "b": _dom("any", (3,))})),
+    _case("Convolution", lambda: (
+        mx.sym.Convolution(mx.sym.Variable("data"),
+                           mx.sym.Variable("w"), mx.sym.Variable("b"),
+                           kernel=(2, 2), num_filter=2),
+        {"data": _dom("any", (1, 2, 4, 4)),
+         "w": _dom("any", (2, 2, 2, 2)), "b": _dom("any", (2,))}),
+        0.06, 2e-3),
+    _case("Deconvolution", lambda: (
+        mx.sym.Deconvolution(mx.sym.Variable("data"),
+                             mx.sym.Variable("w"),
+                             kernel=(2, 2), num_filter=2, no_bias=True),
+        {"data": _dom("any", (1, 2, 3, 3)),
+         "w": _dom("any", (2, 2, 2, 2))}), 0.06, 2e-3),
+    _case("Pooling_avg", lambda: (
+        mx.sym.Pooling(mx.sym.Variable("data"), kernel=(2, 2),
+                       stride=(2, 2), pool_type="avg"),
+        {"data": _dom("any", (1, 2, 4, 4))})),
+    _case("Pooling_max", lambda: (
+        mx.sym.Pooling(mx.sym.Variable("data"), kernel=(2, 2),
+                       stride=(2, 2), pool_type="max"),
+        {"data": _dom("spread", (1, 2, 4, 4))})),
+    _case("Activation_relu", lambda: (
+        mx.sym.Activation(mx.sym.Variable("data"), act_type="relu"),
+        {"data": _dom("off0")})),
+    _case("Activation_tanh", lambda: (
+        mx.sym.Activation(mx.sym.Variable("data"), act_type="tanh"),
+        {"data": _dom("any")})),
+    _case("LeakyReLU", lambda: (
+        mx.sym.LeakyReLU(mx.sym.Variable("data"), act_type="leaky",
+                         slope=0.1),
+        {"data": _dom("off0")})),
+    _case("L2Normalization", lambda: (
+        mx.sym.L2Normalization(mx.sym.Variable("data")),
+        {"data": _dom("pos")})),
+    _case("LRN", lambda: (
+        mx.sym.LRN(mx.sym.Variable("data"), nsize=3),
+        {"data": _dom("pos", (1, 4, 3, 3))}), 0.06, 2e-3),
+    _case("InstanceNorm", lambda: (
+        mx.sym.InstanceNorm(mx.sym.Variable("data"),
+                            mx.sym.Variable("g"), mx.sym.Variable("b")),
+        {"data": _dom("any", (2, 3, 4)), "g": _dom("pos", (3,)),
+         "b": _dom("any", (3,))}), 0.06, 2e-3),
+    _case("UpSampling", lambda: (
+        mx.sym.UpSampling(mx.sym.Variable("data"), scale=2,
+                          sample_type="nearest"),
+        {"data": _dom("any", (1, 2, 3, 3))})),
+]
+
+
+@pytest.mark.parametrize("build,rtol,atol", CASES)
+def test_op_gradient_matches_finite_differences(build, rtol, atol):
+    built = build()
+    sym, location = built[0], built[1]
+    grad_nodes = built[2] if len(built) > 2 else None
+    check_numeric_gradient(sym, location, rtol=rtol, atol=atol,
+                           grad_nodes=grad_nodes)

@@ -1,0 +1,87 @@
+"""Train-tier convergence gates (reference tests/python/train/test_mlp.py
+and test_conv.py assert final accuracy on real data; this repo had no
+accuracy-threshold test before round 3 — VERDICT item 6/8).
+
+Data: sklearn's bundled handwritten-digits set (1797 real 8x8 images,
+10 classes) — the offline stand-in for MNIST in this zero-egress
+environment. Both the MLP and the conv net must actually LEARN: the
+thresholds sit far above the 10% chance floor and fail on any silent
+gradient/optimizer/update breakage that still runs.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _digits():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    X = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.float32)
+    rng = np.random.RandomState(0)
+    perm = rng.permutation(len(X))
+    X, y = X[perm], y[perm]
+    n_train = 1500
+    return (X[:n_train], y[:n_train]), (X[n_train:], y[n_train:])
+
+
+def _fit_and_score(net, reshape=None, num_epoch=30, lr=0.1):
+    (Xtr, ytr), (Xva, yva) = _digits()
+    if reshape:
+        Xtr = Xtr.reshape((-1,) + reshape)
+        Xva = Xva.reshape((-1,) + reshape)
+    train = mx.io.NDArrayIter(Xtr, ytr, batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(Xva, yva, batch_size=50)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    np.random.seed(1)
+    mx.random.seed(1)
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.initializer.Xavier(),
+            num_epoch=num_epoch)
+    val.reset()
+    va = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    train.reset()
+    tr = dict(mod.score(train, mx.metric.Accuracy()))["accuracy"]
+    return tr, va
+
+
+def test_mlp_digits_reaches_97_percent():
+    """reference test_mlp.py gate: assert acc > 0.97."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    train_acc, val_acc = _fit_and_score(net)
+    assert train_acc >= 0.99, train_acc
+    assert val_acc >= 0.95, val_acc
+
+
+def test_lenet_digits_converges():
+    """reference test_conv.py gate: a conv net (conv/pool/BN path) must
+    also cross the accuracy bar."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=32,
+                             pad=(1, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    train_acc, val_acc = _fit_and_score(net, reshape=(1, 8, 8),
+                                        num_epoch=20, lr=0.05)
+    assert train_acc >= 0.99, train_acc
+    assert val_acc >= 0.95, val_acc

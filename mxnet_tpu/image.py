@@ -455,3 +455,160 @@ class ImageIter(DataIter):
     def read_image(self, fname):
         with open(os.path.join(self.path_root or "", fname), "rb") as fin:
             return fin.read()
+
+
+class ImageDetIter(DataIter):
+    """Detection RecordIO iterator (parity src/io/iter_image_det_recordio.cc:563).
+
+    Reads records packed by im2rec from detection .lst files (imdb.py
+    convention: per-image label = [header_width, object_width,
+    (id, xmin, ymin, xmax, ymax, ...)...] with normalized corners) and
+    emits the C++ iterator's exact label contract per image
+    (iter_image_det_recordio.cc:435-444):
+
+        label[0..3] = channels, rows, cols, len(packed_label)
+        label[4:4+len] = the packed label
+        rest = label_pad_value
+
+    The tensor width is 4 + label_pad_width, auto-estimated as the
+    dataset's max packed width when label_pad_width <= 0 (the C++
+    default); rand_mirror flips images AND their box x-coordinates (the
+    det_aug_default behavior — plain augmenters would silently corrupt
+    boxes).
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec,
+                 path_imgidx=None, shuffle=False, label_pad_width=-1,
+                 label_pad_value=-1.0, rand_mirror=False, mean_pixels=None,
+                 scale=1.0, data_name="data", label_name="label", **kwargs):
+        super().__init__()
+        if kwargs:
+            # silently dropping a misspelled/unported C++ param would
+            # train with silently different behavior
+            raise TypeError("ImageDetIter: unsupported parameters %s"
+                            % sorted(kwargs))
+        self.batch_size = batch_size
+        self.check_data_shape(data_shape)
+        self.data_shape = data_shape
+        self.label_pad_value = float(label_pad_value)
+        self.rand_mirror = rand_mirror
+        self.mean_pixels = (np.asarray(mean_pixels, np.float32)
+                            if mean_pixels is not None else None)
+        self.scale = scale
+        if path_imgidx:
+            self.imgrec = recordio.MXIndexedRecordIO(
+                path_imgidx, path_imgrec, "r")
+            self.seq = list(self.imgrec.keys)
+        else:
+            self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+            self.seq = None
+        if shuffle:
+            assert self.seq is not None, "shuffle requires a .idx file"
+        self.shuffle = shuffle
+
+        if label_pad_width > 0:
+            # explicit width: no startup scan; each record is validated
+            # against it as it streams through next()
+            self.pad_width = label_pad_width
+        else:
+            self.pad_width = self._scan_label_widths(path_imgrec)
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + data_shape)]
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, 4 + self.pad_width))]
+        self.cur = 0
+        self.reset()
+
+    @staticmethod
+    def _scan_label_widths(path_imgrec):
+        """One pass over the record file for the max packed-label width
+        (the C++ parser's auto-estimation, iter_image_det_recordio.cc:270)."""
+        rec = recordio.MXRecordIO(path_imgrec, "r")
+        max_width = 0
+        while True:
+            s = rec.read()
+            if s is None:
+                break
+            header, _ = recordio.unpack(s)
+            width = (header.label.size
+                     if isinstance(header.label, np.ndarray) else 1)
+            max_width = max(max_width, width)
+        rec.close()
+        return max_width
+
+    def check_data_shape(self, data_shape):
+        if len(data_shape) != 3 or data_shape[0] not in (1, 3):
+            raise ValueError(
+                "data_shape must be (1|3, H, W), got %s" % (data_shape,))
+
+    def reset(self):
+        self.cur = 0
+        if self.shuffle:
+            np.random.shuffle(self.seq)
+        if self.seq is None:
+            self.imgrec.reset()
+
+    def _next_record(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                return None
+            s = self.imgrec.read_idx(self.seq[self.cur])
+            self.cur += 1
+            return s
+        return self.imgrec.read()
+
+    def _flip_boxes(self, buf):
+        """Mirror normalized x-coords: xmin' = 1 - xmax, xmax' = 1 - xmin
+        (image_det_aug_default.cc HorizontalFlip)."""
+        buf = buf.copy()
+        header_width = int(buf[0])
+        obj_width = int(buf[1])
+        objs = buf[header_width:]
+        n = objs.size // obj_width
+        boxes = objs[: n * obj_width].reshape(n, obj_width)
+        xmin = boxes[:, 1].copy()
+        boxes[:, 1] = 1.0 - boxes[:, 3]
+        boxes[:, 3] = 1.0 - xmin
+        buf[header_width:header_width + n * obj_width] = boxes.ravel()
+        return buf
+
+    def next(self):
+        from PIL import Image
+
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        label = np.full((self.batch_size, 4 + self.pad_width),
+                        self.label_pad_value, np.float32)
+        n = 0
+        while n < self.batch_size:
+            s = self._next_record()
+            if s is None:
+                break
+            header, img = recordio.unpack_img(s)
+            im = Image.fromarray(img.astype(np.uint8))
+            if c == 1:
+                im = im.convert("L")
+            arr = np.asarray(im.resize((w, h)), np.float32)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            buf = np.atleast_1d(np.asarray(header.label, np.float32))
+            if buf.size > self.pad_width:
+                raise MXNetError(
+                    "label_pad_width %d smaller than record's label "
+                    "width %d" % (self.pad_width, buf.size))
+            if self.rand_mirror and np.random.rand() < 0.5:
+                arr = arr[:, ::-1, :]
+                buf = self._flip_boxes(buf)
+            if self.mean_pixels is not None:
+                arr = arr - self.mean_pixels.reshape(1, 1, -1)
+            data[n] = (arr * self.scale).transpose(2, 0, 1)
+            label[n, 0] = c
+            label[n, 1] = h
+            label[n, 2] = w
+            label[n, 3] = buf.size
+            label[n, 4:4 + buf.size] = buf
+            n += 1
+        if n == 0:
+            raise StopIteration
+        return DataBatch([nd.array(data)], [nd.array(label)],
+                         self.batch_size - n)

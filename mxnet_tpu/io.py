@@ -459,4 +459,13 @@ def ImageRecordIter(**kwargs):
     return ImageIter.from_recordio_params(**kwargs)
 
 
+def ImageDetRecordIter(**kwargs):
+    """Detection RecordIO iterator (parity
+    src/io/iter_image_det_recordio.cc:563): variable-width box labels,
+    emitted with the C++ label contract [c, h, w, len, packed..., pad]."""
+    from .image import ImageDetIter
+
+    return ImageDetIter(**kwargs)
+
+
 MXDataIter = DataIter  # reference exposes C-iterator wrapper under this name

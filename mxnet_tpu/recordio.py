@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import collections
 import struct
 
 import numpy as np
@@ -58,7 +59,12 @@ class MXRecordIO(object):
         self.is_open = False
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            # interpreter teardown: builtins (open) may already be gone;
+            # an unflushed idx of a leaked writer is the caller's bug
+            pass
 
     def reset(self):
         self.close()
@@ -170,25 +176,11 @@ class MXIndexedRecordIO(MXRecordIO):
         self.write(buf)
 
 
-IRHeader = struct.Struct("IfQQ")  # flag, label, id, id2
-
-
-class _HeaderTuple(tuple):
-    @property
-    def flag(self):
-        return self[0]
-
-    @property
-    def label(self):
-        return self[1]
-
-    @property
-    def id(self):
-        return self[2]
-
-    @property
-    def id2(self):
-        return self[3]
+# The user-facing header is a namedtuple exactly like the reference
+# (recordio.py IRHeader); the wire layout is flag:uint32 label:float32
+# id:uint64 id2:uint64.
+IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_HDR = struct.Struct("IfQQ")
 
 
 def pack(header, s):
@@ -196,19 +188,19 @@ def pack(header, s):
     flag, label, id_, id2 = header
     if isinstance(label, (list, tuple, np.ndarray)) and not np.isscalar(label):
         label = np.asarray(label, dtype=np.float32)
-        hdr = IRHeader.pack(len(label), 0.0, id_, id2)
+        hdr = _HDR.pack(len(label), 0.0, id_, id2)
         return hdr + label.tobytes() + s
-    return IRHeader.pack(0, float(label), id_, id2) + s
+    return _HDR.pack(0, float(label), id_, id2) + s
 
 
 def unpack(s):
     """Unpack a record payload into (IRHeader, bytes)."""
-    flag, label, id_, id2 = IRHeader.unpack(s[: IRHeader.size])
-    s = s[IRHeader.size:]
+    flag, label, id_, id2 = _HDR.unpack(s[: _HDR.size])
+    s = s[_HDR.size:]
     if flag > 0:
         label = np.frombuffer(s[: flag * 4], dtype=np.float32)
         s = s[flag * 4:]
-    return _HeaderTuple((flag, label, id_, id2)), s
+    return IRHeader(flag, label, id_, id2), s
 
 
 def unpack_img(s, iscolor=-1):

@@ -95,7 +95,6 @@ CASES = [
         mx.sym.smooth_l1(mx.sym.Variable("data"), scalar=1.0),
         {"data": _dom("off0")})),
     # ---- scalar variants ----------------------------------------------------
-    _unary("__add_scalar", attrs=None) if False else
     _case("plus_scalar", lambda: (
         mx.sym.Variable("data") + 1.5, {"data": _dom("any")})),
     _case("rminus_scalar", lambda: (

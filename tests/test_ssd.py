@@ -98,3 +98,101 @@ def test_tiny_ssd_detection_forward():
     # every row: [cls_id(-1 = suppressed), score, x1, y1, x2, y2]
     assert ((out[..., 0] >= -1) & (out[..., 0] < num_classes)).all()
     assert ((out[..., 1] >= 0) & (out[..., 1] <= 1)).all()
+
+
+def _pack_det_rec(tmp_path, n_images=6, size=24):
+    """Pack synthetic detection data the way the reference SSD pipeline
+    does (imdb.py save_imglist -> im2rec): per-image label
+    [header_width=2, object_width=5, (cls, xmin, ymin, xmax, ymax)...]."""
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(3)
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    truths = []
+    for i in range(n_images):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        n_obj = 1 + i % 2
+        objs = []
+        for _ in range(n_obj):
+            x0, y0 = rng.uniform(0.05, 0.4, 2)
+            x1, y1 = x0 + rng.uniform(0.2, 0.5), y0 + rng.uniform(0.2, 0.5)
+            objs.append([rng.randint(0, 3), x0, y0, min(x1, 0.95),
+                         min(y1, 0.95)])
+        label = np.asarray([2, 5] + [v for o in objs for v in o], np.float32)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, img_fmt=".png"))
+        truths.append(np.asarray(objs, np.float32))
+    writer.close()
+    return rec_path, idx_path, truths
+
+
+def test_image_det_record_iter_contract(tmp_path):
+    """The C++ ImageDetRecordIter label contract
+    (iter_image_det_recordio.cc:435-444): [c, h, w, len, packed, -1 pad]."""
+    rec_path, idx_path, truths = _pack_det_rec(tmp_path)
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, batch_size=3,
+        data_shape=(3, 16, 16))
+    batch = next(iter(it))
+    label = batch.label[0].asnumpy()
+    assert label.shape == (3, 4 + 2 + 2 * 5)  # max 2 objects
+    for row, truth in zip(label, truths):
+        assert tuple(row[:3]) == (3.0, 16.0, 16.0)
+        buf_len = int(row[3])
+        assert buf_len == 2 + truth.size
+        assert row[4] == 2 and row[5] == 5
+        np.testing.assert_allclose(row[6:6 + truth.size], truth.ravel(),
+                                   rtol=1e-6)
+        assert np.all(row[4 + buf_len:] == -1.0)
+    assert batch.data[0].shape == (3, 3, 16, 16)
+
+
+def test_ssd_trains_from_rec_file(tmp_path):
+    """End-to-end VERDICT item 9: SSD trains a step from a packed .rec
+    through ImageDetRecordIter (no synthetic NDArrayIter shortcut)."""
+    rec_path, idx_path, _ = _pack_det_rec(tmp_path)
+    batch_size = 3
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, batch_size=batch_size,
+        data_shape=(3, 16, 16), scale=1.0 / 255)
+
+    num_classes = 3
+    _, (loc_preds, cls_preds, anchors) = _tiny_detector(num_classes)
+    net = ssd.training_head(loc_preds, cls_preds, anchors, num_classes)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.cpu())
+
+    losses = []
+    metric = ssd.MultiBoxMetric()
+    for epoch in range(6):
+        it.reset()
+        for batch in it:
+            label = batch.label[0].asnumpy()
+            # SSD's DetRecordIter reshape (example/ssd/dataset/iterator.py):
+            # strip the 4-value size header + the [hw, ow] packing header,
+            # view as (batch, max_objects, object_width)
+            header_width = int(label[0, 4])
+            obj_width = int(label[0, 5])
+            start = 4 + header_width
+            max_obj = (label.shape[1] - start) // obj_width
+            boxes = label[:, start:start + max_obj * obj_width].reshape(
+                batch_size, max_obj, obj_width)
+            det_batch = mx.io.DataBatch(data=batch.data,
+                                        label=[mx.nd.array(boxes)])
+            if not mod.binded:
+                mod.bind(data_shapes=[("data", (batch_size, 3, 16, 16))],
+                         label_shapes=[("label", boxes.shape)])
+                mod.init_params(initializer=mx.init.Xavier())
+                mod.init_optimizer(
+                    optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.1})
+            mod.forward(det_batch, is_train=True)
+            metric.reset()
+            mod.update_metric(metric, det_batch.label)
+            mod.backward()
+            mod.update()
+            losses.append(metric.get()[1][0])
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

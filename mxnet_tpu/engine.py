@@ -72,24 +72,30 @@ class ThreadedEngine:
         opr = _OprBlock(fn, const_vars, mutable_vars)
         with self._lock:
             self._inflight += 1
-        # append dependencies (AppendReadDependency/AppendWriteDependency)
-        pending = 0
+        # Self-hold refcount: opr.wait starts at 1 so a producer that
+        # completes DURING this enqueue loop can decrement freely without
+        # racing a later bulk assignment (the increment happens-before
+        # the queue append, both under the var lock, so _on_complete can
+        # only ever see an already-counted entry).
+        opr.wait = 1
         for var in const_vars:
             with var._lock:
                 if var._pending_write or var._queue:
+                    with opr.lock:
+                        opr.wait += 1
                     var._queue.append(("r", opr))
-                    pending += 1
                 else:
                     var._num_pending_reads += 1
         for var in mutable_vars:
             with var._lock:
                 if var._pending_write or var._num_pending_reads or var._queue:
+                    with opr.lock:
+                        opr.wait += 1
                     var._queue.append(("w", opr))
-                    pending += 1
                 else:
                     var._pending_write = True
         with opr.lock:
-            opr.wait = pending
+            opr.wait -= 1  # release the self-hold
             ready = opr.wait == 0
         if ready:
             self._dispatch(opr)

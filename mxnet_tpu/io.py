@@ -4,17 +4,15 @@ Parity: reference ``python/mxnet/io.py`` (DataIter/DataBatch/DataDesc,
 NDArrayIter, ResizeIter, PrefetchingIter) plus Python-native equivalents of
 the C++ iterators in ``src/io/`` (MNISTIter ← iter_mnist.cc, CSVIter ←
 iter_csv.cc, ImageRecordIter ← iter_image_recordio_2.cc). The reference's
-PrefetcherIter double-buffering (iter_prefetcher.h) is kept as a
-background-thread prefetcher feeding device puts — the host-side pipeline
-design SURVEY.md §7 maps 1:1.
+PrefetcherIter double-buffering (iter_prefetcher.h) is kept, with produce
+ops scheduled on the host dependency engine (mxnet_tpu.engine) — the
+host-side pipeline design SURVEY.md §7 maps 1:1.
 """
 from __future__ import annotations
 
 import gzip
 import os
 import struct
-import threading
-import queue as _queue
 from collections import namedtuple
 
 import numpy as np
@@ -123,15 +121,24 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread prefetcher over one or more iterators.
+    """Prefetcher over one or more iterators, scheduled on the host
+    dependency engine.
 
-    Parity: io.py:298 (python) and the native PrefetcherIter
-    (src/io/iter_prefetcher.h) — double-buffers batches on worker threads
-    so host decode overlaps device compute.
+    Parity: io.py:298 (python PrefetchingIter) and the native
+    PrefetcherIter (src/io/iter_prefetcher.h) — the next batch is
+    produced on an engine worker while the caller consumes the current
+    one, so host decode overlaps device compute. Each source iterator
+    owns an engine Var; produce ops take it as their mutable var, which
+    serializes production per source exactly like the reference's
+    engine-var discipline (and under MXNET_ENGINE_TYPE=NaiveEngine the
+    whole pipeline runs synchronously — the same debug escape hatch,
+    threaded_engine.h:329, applied to host IO).
     """
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
+        from . import engine as _engine
+
         if not isinstance(iters, list):
             iters = [iters]
         self.n_iter = len(iters)
@@ -140,40 +147,38 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0].shape[0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        self._engine = _engine.get()
+        self._slots = [self._engine.new_variable()
+                       for _ in range(self.n_iter)]
+        self.current_batch = None
+        self.next_batch = [None] * self.n_iter
+        self._errors = [None] * self.n_iter
+        self._prefetch_all()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+    def _prefetch(self, i):
+        def _produce():
+            try:
+                self.next_batch[i] = self.iters[i].next()
+            except StopIteration:
+                self.next_batch[i] = None
+            except Exception as e:  # surfaced in the consumer thread —
+                # swallowing it would silently re-serve a stale batch
+                self.next_batch[i] = None
+                self._errors[i] = e
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i])
-            for i in range(self.n_iter)
-        ]
-        for thread in self.prefetch_threads:
-            thread.daemon = True
-            thread.start()
+        self._engine.push(_produce, mutable_vars=(self._slots[i],))
 
-    def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
-        for thread in self.prefetch_threads:
-            thread.join()
+    def _prefetch_all(self):
+        for i in range(self.n_iter):
+            self._prefetch(i)
+
+    def _await_batches(self):
+        for v in self._slots:
+            self._engine.wait_for_var(v)
+        for i, err in enumerate(self._errors):
+            if err is not None:
+                self._errors[i] = None
+                raise err
 
     @property
     def provide_data(self):
@@ -210,18 +215,13 @@ class PrefetchingIter(DataIter):
         )
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        self._await_batches()  # let in-flight produces land first
         for i in self.iters:
             i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._prefetch_all()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
+        self._await_batches()
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"
@@ -238,10 +238,8 @@ class PrefetchingIter(DataIter):
             provide_data=self.provide_data,
             provide_label=self.provide_label,
         )
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        # produce the NEXT round while the caller consumes this one
+        self._prefetch_all()
         return True
 
     def next(self):

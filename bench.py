@@ -221,8 +221,15 @@ def init_backend():
         INIT_RETRIES, INIT_TIMEOUT_S), True
 
 
-def run_resnet50(jax, jnp, batch, steps, warmup):
-    """Train-step ResNet-50 at `batch`; return (img_s, step_ms, flops)."""
+def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False):
+    """Train-step ResNet-50 at `batch`; return (img_s, step_ms, flops).
+
+    bf16=True runs the reference's reduced-precision recipe
+    (example/image-classification/symbols/resnet_fp16.py: fp16 compute,
+    fp32 master weights) the TPU way: master params stay f32, the loss
+    closure casts params+data to bfloat16 so conv/matmul hit the MXU at
+    full rate, BatchNorm statistics still accumulate in f32 inside the
+    op, and cast-transpose upcasts gradients back to f32 for the update."""
     from mxnet_tpu.executor import _GraphProgram
     from mxnet_tpu.models.resnet import get_symbol
 
@@ -258,12 +265,14 @@ def run_resnet50(jax, jnp, batch, steps, warmup):
 
     def train_step(params, moms, aux, data, label):
         def loss_fn(ps):
+            if bf16:
+                ps = {n: v.astype(jnp.bfloat16) for n, v in ps.items()}
             args = dict(ps)
-            args["data"] = data
+            args["data"] = data.astype(jnp.bfloat16) if bf16 else data
             args["softmax_label"] = label
             outs, new_aux = program(args, aux, None, True)
             # SoftmaxOutput carries its own backward; drive vjp with sum
-            return jnp.sum(outs[0]), new_aux
+            return jnp.sum(outs[0].astype(jnp.float32)), new_aux
 
         grads, new_aux = jax.grad(loss_fn, has_aux=True)(params)
         new_params, new_moms = {}, {}
@@ -407,6 +416,18 @@ def main():
         except Exception as e:
             log("batch-%d run failed: %s" % (BATCH2, e))
             out["batch%d_error" % BATCH2] = str(e)[:200]
+        # bf16 mixed-precision row (reference fp16 recipe, TPU dtype):
+        # this is the configuration the MXU is built for
+        try:
+            img_s3, step_ms3, flops3 = run_resnet50(
+                jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP, bf16=True)
+            out["bf16_batch%d_images_per_sec" % BATCH2] = round(img_s3, 2)
+            out["bf16_batch%d_step_ms" % BATCH2] = round(step_ms3, 2)
+            out.update(mfu_fields(
+                "bf16_batch%d_" % BATCH2, step_ms3, flops3, peak))
+        except Exception as e:
+            log("bf16 run failed: %s" % e)
+            out["bf16_error"] = str(e)[:200]
     emit(out)
 
 

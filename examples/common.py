@@ -38,7 +38,12 @@ def add_fit_args(parser):
     parser.add_argument("--ctx", type=str, default="tpu",
                         choices=["tpu", "cpu", "gpu"])
     parser.add_argument("--num-devices", type=int, default=1)
-    parser.add_argument("--kv-store", type=str, default="local")
+    # "auto": single device -> no kvstore; multi-device -> 'device' (the
+    # fused in-XLA allreduce path); multi-process -> dist_device_sync.
+    # The reference auto-upgrades the same way (model.py _create_kvstore);
+    # defaulting to 'local' silently kept multi-device runs off the
+    # flagship fused path (round-2 finding).
+    parser.add_argument("--kv-store", type=str, default="auto")
     parser.add_argument("--num-epochs", type=int, default=10)
     parser.add_argument("--lr", type=float, default=0.1)
     parser.add_argument("--lr-factor", type=float, default=0.1)
@@ -81,7 +86,18 @@ def fit(args, network, train, val=None, **kwargs):
     """Parity common/fit.py:89 — the canonical Module.fit driver."""
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)-15s %(message)s")
-    kv = mx.kv.create(args.kv_store)
+    kv_name = args.kv_store
+    if kv_name == "auto":
+        import os as _os
+
+        if int(_os.environ.get("DMLC_NUM_WORKER",
+                               _os.environ.get("JAX_NUM_PROCESSES", 1))) > 1:
+            kv_name = "dist_device_sync"
+        elif args.num_devices > 1:
+            kv_name = "device"
+        else:
+            kv_name = "local"
+    kv = mx.kv.create(kv_name)
     ctx = get_context(args)
     model = mx.mod.Module(network, context=ctx)
 

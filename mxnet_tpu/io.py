@@ -153,6 +153,7 @@ class PrefetchingIter(DataIter):
         self.current_batch = None
         self.next_batch = [None] * self.n_iter
         self._errors = [None] * self.n_iter
+        self._pending = [None] * self.n_iter  # opr handles from push()
         self._prefetch_all()
 
     def _prefetch(self, i):
@@ -166,15 +167,22 @@ class PrefetchingIter(DataIter):
                 self.next_batch[i] = None
                 self._errors[i] = e
 
-        self._engine.push(_produce, mutable_vars=(self._slots[i],))
+        self._pending[i] = self._engine.push(
+            _produce, mutable_vars=(self._slots[i],))
 
     def _prefetch_all(self):
         for i in range(self.n_iter):
             self._prefetch(i)
 
     def _await_batches(self):
-        for v in self._slots:
-            self._engine.wait_for_var(v)
+        for i, opr in enumerate(self._pending):
+            # wait on the produce op itself when the engine hands back a
+            # completion handle — a wait_for_var would push a whole extra
+            # read-op per batch; engines without handles fall back to it
+            if opr is not None and hasattr(opr, "done"):
+                opr.done.wait()
+            else:
+                self._engine.wait_for_var(self._slots[i])
         for i, err in enumerate(self._errors):
             if err is not None:
                 self._errors[i] = None

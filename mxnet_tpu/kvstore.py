@@ -164,8 +164,15 @@ class KVStore(object):
         always runs in-process."""
         if "dist" in self.type and self._size > 1:
             # serialize/deserialize to mirror the reference's server-side
-            # transport (and catch unpicklable optimizers early)
-            optimizer = pickle.loads(pickle.dumps(optimizer))
+            # transport (and catch unpicklable optimizers early). The
+            # bound symbol is transport-hostile (op defs hold lambdas)
+            # and already spent: set_lr_mult/set_wd_mult read it at
+            # construction, so the wire copy travels without it.
+            import copy
+
+            clone = copy.copy(optimizer)  # caller's object untouched
+            clone.sym = None
+            optimizer = pickle.loads(pickle.dumps(clone))
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
 

@@ -1,12 +1,16 @@
-"""PythonModule / PythonLossModule: pure-python modules.
+"""PythonModule / PythonLossModule: modules written directly in python.
 
-Parity: reference ``python/mxnet/module/python_module.py`` (338 LoC).
+Capability parity with reference ``python/mxnet/module/python_module.py``:
+a base that stubs out the parameter/optimizer surface (python modules
+own no learnable state by default) so subclasses only implement the
+compute they care about, plus the loss-module specialization whose
+backward is a user-supplied gradient function. Re-authored as a
+shape-pipeline: bind() records input shapes and asks the subclass for
+output shapes; everything stateful is a no-op by design.
 """
 from __future__ import annotations
 
 import logging
-
-import numpy as np
 
 from .. import ndarray as nd
 from ..initializer import Uniform
@@ -14,44 +18,32 @@ from .base_module import BaseModule
 
 
 class PythonModule(BaseModule):
-    """A convenient module class that implements many of the module APIs as
-    empty functions (parity python_module.py:19)."""
+    """Base for computation written in python rather than symbols.
 
-    def __init__(self, data_names, label_names, output_names, logger=logging):
+    The parameter-facing API (get/init params, update, optimizer,
+    monitor) is intentionally inert — subclasses with state override
+    what they need."""
+
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
         super().__init__(logger=logger)
-        if isinstance(data_names, tuple):
-            data_names = list(data_names)
-        if isinstance(label_names, tuple):
-            label_names = list(label_names)
-        self._data_names = data_names
-        self._label_names = label_names
+        self._data_names = list(data_names)
+        self._label_names = list(label_names)
         self._output_names = output_names
         self._data_shapes = None
         self._label_shapes = None
         self._output_shapes = None
 
-    @property
-    def data_names(self):
-        return self._data_names
+    # shapes/names are plain recorded state
+    data_names = property(lambda self: self._data_names)
+    output_names = property(lambda self: self._output_names)
+    data_shapes = property(lambda self: self._data_shapes)
+    label_shapes = property(lambda self: self._label_shapes)
+    output_shapes = property(lambda self: self._output_shapes)
 
-    @property
-    def output_names(self):
-        return self._output_names
-
-    @property
-    def data_shapes(self):
-        return self._data_shapes
-
-    @property
-    def label_shapes(self):
-        return self._label_shapes
-
-    @property
-    def output_shapes(self):
-        return self._output_shapes
-
+    # -- stateless surface ----------------------------------------------
     def get_params(self):
-        return (dict(), dict())
+        return {}, {}
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False):
@@ -61,27 +53,7 @@ class PythonModule(BaseModule):
         pass
 
     def update_metric(self, eval_metric, labels):
-        if self._label_shapes is None:
-            pass
-        else:
-            pass
-
-    def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        if self.binded and not force_rebind:
-            self.logger.warning("Already binded, ignoring bind()")
-            return
-        self.for_training = for_training
-        self.inputs_need_grad = inputs_need_grad
-        assert grad_req == "write"
-        self._data_shapes = data_shapes
-        self._label_shapes = label_shapes
-        self._output_shapes = self._compute_output_shapes()
-        self.binded = True
-
-    def _compute_output_shapes(self):
-        raise NotImplementedError()
+        pass
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -91,27 +63,42 @@ class PythonModule(BaseModule):
     def install_monitor(self, mon):
         pass
 
+    # -- binding: record inputs, derive outputs -------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        assert grad_req == "write"
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError()
+
 
 class PythonLossModule(PythonModule):
-    """A loss module whose backward is a python function of the forward
-    inputs (parity python_module.py:189)."""
+    """A loss head in python: forward passes scores through; backward
+    produces d(loss)/d(scores) via ``grad_func(scores, labels)``."""
 
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
-        super().__init__(
-            list(data_names), list(label_names), [name + "_output"],
-            logger=logger
-        )
+        assert len(data_names) == 1 and len(label_names) == 1
+        super().__init__(list(data_names), list(label_names),
+                         [name + "_output"], logger=logger)
         self._name = name
-        assert len(data_names) == 1
-        assert len(label_names) == 1
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
+        self._grad_func = grad_func
         self._scores = None
         self._labels = None
         self._scores_grad = None
-        if grad_func is not None:
-            assert callable(grad_func)
-        self._grad_func = grad_func
 
     def _compute_output_shapes(self):
         return [(self._name + "_output", self._data_shapes[0][1])]
@@ -133,13 +120,13 @@ class PythonLossModule(PythonModule):
         self._backward_impl()
 
     def _backward_impl(self):
-        if self._grad_func is not None:
-            grad = self._grad_func(self._scores, self._labels)
-            if not isinstance(grad, nd.NDArray):
-                grad = nd.array(grad)
-            self._scores_grad = grad
-        else:
+        """Subclass extension point (reference contract): compute
+        self._scores_grad from self._scores/self._labels."""
+        if self._grad_func is None:
             raise NotImplementedError()
+        grad = self._grad_func(self._scores, self._labels)
+        self._scores_grad = (grad if isinstance(grad, nd.NDArray)
+                             else nd.array(grad))
 
     def get_input_grads(self, merge_multi_context=True):
         assert merge_multi_context is True

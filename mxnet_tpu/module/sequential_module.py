@@ -1,6 +1,11 @@
-"""SequentialModule: chain of modules.
+"""SequentialModule: a pipeline of modules executed in order.
 
-Parity: reference ``python/mxnet/module/sequential_module.py`` (416 LoC).
+Capability parity with reference ``python/mxnet/module/
+sequential_module.py``: forward threads each stage's outputs into the
+next stage's data, backward threads input-gradients back, and per-stage
+metadata (``take_labels``, ``auto_wiring``) controls label routing and
+name re-wiring at bind time. Re-authored around a (module, meta) stage
+list with small helpers instead of the reference's inline loops.
 """
 from __future__ import annotations
 
@@ -9,48 +14,53 @@ import logging
 from ..initializer import Uniform
 from .base_module import BaseModule
 
+# reference-compatible meta key names
+META_TAKE_LABELS = "take_labels"
+META_AUTO_WIRING = "auto_wiring"
+_KNOWN_META = (META_TAKE_LABELS, META_AUTO_WIRING)
+
 
 class SequentialModule(BaseModule):
-    META_TAKE_LABELS = "take_labels"
-    META_AUTO_WIRING = "auto_wiring"
+    META_TAKE_LABELS = META_TAKE_LABELS
+    META_AUTO_WIRING = META_AUTO_WIRING
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages = []  # (module, meta dict)
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set(
-            [getattr(SequentialModule, x) for x in dir(SequentialModule)
-             if x.startswith("META_")]
-        )
 
-    def add(self, module, **kwargs):
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta \"%s\", a typo?" % key
-        self._metas.append(kwargs)
+    # -- construction ---------------------------------------------------
+    def add(self, module, **meta):
+        for key in meta:
+            if key not in _KNOWN_META:
+                raise ValueError('Unknown meta "%s", a typo?' % key)
+        self._stages.append((module, meta))
+        # adding a stage invalidates any previous bind
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
     @property
+    def _modules(self):  # introspection convenience (tests use it)
+        return [m for m, _meta in self._stages]
+
+    def _takes_labels(self, meta):
+        return bool(meta.get(META_TAKE_LABELS))
+
+    # -- introspection --------------------------------------------------
+    @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0][0].data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1][0].output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0][0].data_shapes
 
     @property
     def label_shapes(self):
@@ -60,49 +70,42 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1][0].output_shapes
 
+    # -- parameters -----------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for module, _meta in self._stages:
+            a, x = module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
-        for module in self._modules:
-            module.init_params(
-                initializer=initializer, arg_params=arg_params,
-                aux_params=aux_params, allow_missing=allow_missing,
-                force_init=force_init
-            )
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, (
-                    "Duplicated parameter names: "
-                    + "name \"%s\" in layer %d (%s) is already " % (
-                        name, i, type(modules[i]))
-                    + "used in layer %d (%s)." % (
-                        known_names[name], type(modules[known_names[name]]))
-                )
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        owners = {}
+        for i, (module, _meta) in enumerate(self._stages):
+            module.init_params(initializer=initializer,
+                               arg_params=arg_params,
+                               aux_params=aux_params,
+                               allow_missing=allow_missing,
+                               force_init=force_init)
+            a, x = module.get_params()
+            for name in list(a) + list(x):
+                if name in owners:
+                    raise ValueError(
+                        'Duplicated parameter names: "%s" in layer %d (%s) '
+                        "is already used in layer %d (%s)."
+                        % (name, i, type(module), owners[name],
+                           type(self._stages[owners[name]][0])))
+                owners[name] = i
         self.params_initialized = True
 
+    # -- binding --------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -112,40 +115,29 @@ class SequentialModule(BaseModule):
         if inputs_need_grad:
             assert for_training
         assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty SequentialModule"
+        assert self._stages, "Attempting to bind an empty SequentialModule"
         self.binded = True
-        self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-
-            my_inputs_need_grad = bool(
-                inputs_need_grad or (for_training and i_layer > 0)
-            )
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [
-                    (new_name, shape)
-                    for (new_name, (_, shape)) in zip(data_names, my_data_shapes)
-                ]
+        feed = data_shapes
+        label_used = False
+        for i, (module, meta) in enumerate(self._stages):
+            stage_labels = label_shapes if self._takes_labels(meta) else None
+            label_used = label_used or stage_labels is not None
+            if meta.get(META_AUTO_WIRING):
+                names = module.data_names
+                assert len(names) == len(feed)
+                feed = [(new, shape)
+                        for new, (_old, shape) in zip(names, feed)]
             module.bind(
-                data_shapes=my_data_shapes, label_shapes=my_label_shapes,
+                data_shapes=feed, label_shapes=stage_labels,
                 for_training=for_training,
-                inputs_need_grad=my_inputs_need_grad,
-                force_rebind=force_rebind, shared_module=None, grad_req=grad_req
-            )
-            my_data_shapes = module.output_shapes
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+                # interior stages need input grads to keep backprop flowing
+                inputs_need_grad=bool(inputs_need_grad
+                                      or (for_training and i > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            feed = module.output_shapes
+        self._label_shapes = label_shapes if label_used else None
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -154,67 +146,66 @@ class SequentialModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(
-                kvstore=kvstore, optimizer=optimizer,
-                optimizer_params=optimizer_params, force_init=force_init
-            )
+        for module, _meta in self._stages:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
         self.optimizer_initialized = True
 
+    # -- compute --------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         from ..io import DataBatch
 
-        data_batch = DataBatch(
-            data=data_batch.data, label=data_batch.label, pad=data_batch.pad,
-            index=data_batch.index, provide_data=data_batch.provide_data,
-            provide_label=data_batch.provide_label,
-        )
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = DataBatch(
+            data=data_batch.data, label=data_batch.label,
+            pad=data_batch.pad, index=data_batch.index,
+            provide_data=data_batch.provide_data,
+            provide_label=data_batch.provide_label)
+        last = len(self._stages) - 1
+        for i, (module, _meta) in enumerate(self._stages):
+            module.forward(batch, is_train=is_train)
+            if i == last:
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_names = [x[0] for x in module.output_shapes]
-                assert len(data_names) == len(data_batch.data)
-                data_batch.provide_data = [
-                    (name, x.shape)
-                    for name, x in zip(data_names, data_batch.data)
-                ]
+            # thread outputs into the next stage's data slots
+            batch.data = module.get_outputs()
+            names = [n for n, _s in module.output_shapes]
+            assert len(names) == len(batch.data)
+            batch.provide_data = [
+                (n, x.shape) for n, x in zip(names, batch.data)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(
-                range(len(self._modules)), self._modules))):
+        for i in range(len(self._stages) - 1, -1, -1):
+            module = self._stages[i][0]
             module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+            if i:
+                out_grads = module.get_input_grads()
 
     def update(self):
-        assert self.binded and self.params_initialized and self.optimizer_initialized
-        for module in self._modules:
+        assert (self.binded and self.params_initialized
+                and self.optimizer_initialized)
+        for module, _meta in self._stages:
             module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context=merge_multi_context)
+        return self._stages[-1][0].get_outputs(
+            merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._modules[0].get_input_grads(
-            merge_multi_context=merge_multi_context
-        )
+        assert (self.binded and self.params_initialized
+                and self.inputs_need_grad)
+        return self._stages[0][0].get_input_grads(
+            merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
+        for module, meta in self._stages:
+            if self._takes_labels(meta):
                 module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
+        for module, _meta in self._stages:
             module.install_monitor(mon)

@@ -1,7 +1,10 @@
 """Bucketed sequence iterators.
 
-Parity: reference ``python/mxnet/rnn/io.py`` (BucketSentenceIter:61,
-encode_sentences).
+Capability parity with reference ``python/mxnet/rnn/io.py``
+(BucketSentenceIter, encode_sentences). Buckets are the XLA-friendly
+shape discipline (SURVEY.md §3.5): variable-length sequences pad into a
+few static widths, one compiled program per width. Re-authored around a
+per-bucket matrix + a flat (bucket, row-offset) schedule.
 """
 from __future__ import annotations
 
@@ -16,105 +19,101 @@ from ..io import DataBatch, DataIter, DataDesc
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0):
-    """Parity rnn/io.py:17."""
-    idx = start_label
-    if vocab is None:
+    """Tokenize nested word lists to int ids, growing the vocab only
+    when the caller did not supply one."""
+    grow = vocab is None
+    if grow:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
-    else:
-        new_vocab = False
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, "Unknown token %s" % word
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+    next_id = start_label
+    encoded = []
+    for sentence in sentences:
+        ids = []
+        for token in sentence:
+            if token not in vocab:
+                if not grow:
+                    raise AssertionError("Unknown token %s" % token)
+                if next_id == invalid_label:
+                    next_id += 1
+                vocab[token] = next_id
+                next_id += 1
+            ids.append(vocab[token])
+        encoded.append(ids)
+    return encoded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """Parity rnn/io.py:61 — buckets variable-length sequences into a few
-    static shapes (the XLA-friendly shape discipline, SURVEY.md §3.5)."""
+    """Pads each sentence into the smallest bucket that fits and serves
+    (data, next-token label) batches of one bucket at a time."""
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name="data", label_name="softmax_label", dtype="float32"):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32"):
         super().__init__()
         if not buckets:
-            buckets = [
-                i for i, j in enumerate(np.bincount([len(s) for s in sentences]))
-                if j >= batch_size
-            ]
-        buckets.sort()
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = bisect.bisect_left(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
+            # auto-buckets: every length with at least one full batch
+            counts = np.bincount([len(s) for s in sentences])
+            buckets = [length for length, n in enumerate(counts)
+                       if n >= batch_size]
+        self.buckets = sorted(buckets)
+
+        per_bucket = [[] for _ in self.buckets]
+        n_discarded = 0
+        for sentence in sentences:
+            slot = bisect.bisect_left(self.buckets, len(sentence))
+            if slot == len(self.buckets):
+                n_discarded += 1
                 continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[: len(sent)] = sent
-            self.data[buck].append(buff)
-        # reshape keeps empty buckets 2-D ((0, len)) so reset()'s label
-        # shifting indexes them uniformly
-        self.data = [
-            np.asarray(i, dtype=dtype).reshape(-1, b)
-            for i, b in zip(self.data, buckets)
-        ]
-        print("WARNING: discarded %d sentences longer than the largest bucket." % ndiscard)
+            row = np.full((self.buckets[slot],), invalid_label, dtype=dtype)
+            row[:len(sentence)] = sentence
+            per_bucket[slot].append(row)
+        # (0, width) for empty buckets keeps label shifting uniform
+        self.data = [np.asarray(rows, dtype=dtype).reshape(-1, width)
+                     for rows, width in zip(per_bucket, self.buckets)]
+        print("WARNING: discarded %d sentences longer than the largest "
+              "bucket." % n_discarded)
 
         self.batch_size = batch_size
-        self.buckets = buckets
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
         self.major_axis = 0
-        self.default_bucket_key = max(buckets)
-        self.provide_data = [
-            DataDesc(data_name, (batch_size, self.default_bucket_key))
+        self.default_bucket_key = max(self.buckets)
+        default = (batch_size, self.default_bucket_key)
+        self.provide_data = [DataDesc(data_name, default)]
+        self.provide_label = [DataDesc(label_name, default)]
+        # schedule: every full batch as a (bucket index, row offset) pair
+        self.idx = [
+            (b, off)
+            for b, rows in enumerate(self.data)
+            for off in range(0, len(rows) - batch_size + 1, batch_size)
         ]
-        self.provide_label = [
-            DataDesc(label_name, (batch_size, self.default_bucket_key))
-        ]
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in range(0, len(buck) - batch_size + 1, batch_size)])
         self.curr_idx = 0
         self.reset()
 
     def reset(self):
         self.curr_idx = 0
         random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-        self.nddata = []
-        self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(nd.array(buck, dtype=self.dtype))
-            self.ndlabel.append(nd.array(label, dtype=self.dtype))
+        for rows in self.data:
+            np.random.shuffle(rows)
+        # language-model targets: the sequence shifted left by one
+        self.nddata, self.ndlabel = [], []
+        for rows in self.data:
+            target = np.roll(rows, -1, axis=1)
+            target[:, -1] = self.invalid_label
+            self.nddata.append(nd.array(rows, dtype=self.dtype))
+            self.ndlabel.append(nd.array(target, dtype=self.dtype))
 
     def next(self):
         if self.curr_idx == len(self.idx):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
+        bucket, off = self.idx[self.curr_idx]
         self.curr_idx += 1
-        data = self.nddata[i][j : j + self.batch_size]
-        label = self.ndlabel[i][j : j + self.batch_size]
+        sl = slice(off, off + self.batch_size)
+        data, label = self.nddata[bucket][sl], self.ndlabel[bucket][sl]
         return DataBatch(
             [data], [label], pad=0,
-            bucket_key=self.buckets[i],
+            bucket_key=self.buckets[bucket],
             provide_data=[DataDesc(self.data_name, data.shape)],
             provide_label=[DataDesc(self.label_name, label.shape)],
         )

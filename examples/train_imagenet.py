@@ -39,6 +39,19 @@ def get_symbol(args):
 
 
 def get_iters(args):
+    if args.benchmark:
+        # synthetic data at the training shape (reference common/fit.py
+        # --benchmark): one generated batch cycled N times, so memory
+        # stays constant however long the measurement runs
+        import numpy as np
+
+        shape = tuple(int(x) for x in args.image_shape.split(","))
+        rng = np.random.RandomState(0)
+        X = rng.rand(args.batch_size, *shape).astype(np.float32)
+        y = rng.randint(0, args.num_classes,
+                        args.batch_size).astype(np.float32)
+        inner = mx.io.NDArrayIter(X, y, batch_size=args.batch_size)
+        return mx.io.ResizeIter(inner, args.benchmark), None
     train = mx.io.ImageRecordIter(
         path_imgrec=args.data_train,
         data_shape=tuple(int(x) for x in args.image_shape.split(",")),
@@ -60,13 +73,18 @@ def get_iters(args):
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__)
     add_fit_args(parser)
-    parser.add_argument("--data-train", type=str, required=True)
+    parser.add_argument("--data-train", type=str, default=None)
     parser.add_argument("--data-val", type=str, default=None)
     parser.add_argument("--image-shape", type=str, default="3,224,224")
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--data-nthreads", type=int, default=4)
+    parser.add_argument("--benchmark", type=int, default=0,
+                        help="train N synthetic batches instead of a "
+                             "dataset (reference --benchmark)")
     parser.set_defaults(network="resnet", num_layers=50, batch_size=32,
                         lr_step_epochs="30,60,90")
     args = parser.parse_args()
+    if not args.data_train and not args.benchmark:
+        parser.error("either --data-train or --benchmark is required")
     train, val = get_iters(args)
     fit(args, get_symbol(args), train, val)

@@ -60,15 +60,19 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__)
     add_fit_args(parser)
     parser.add_argument("--data-train", type=str, default=None)
+    parser.add_argument("--data-idx", type=str, default=None,
+                        help=".idx file enabling shuffled epochs")
     parser.add_argument("--num-classes", type=int, default=20)
     parser.set_defaults(batch_size=8, num_epochs=2, lr=0.05, ctx="cpu")
     args = parser.parse_args()
 
     if args.data_train:
         net = ssd.get_symbol_train(num_classes=args.num_classes)
-        train = mx.io.ImageRecordIter(
-            path_imgrec=args.data_train, data_shape=(3, 300, 300),
-            batch_size=args.batch_size, shuffle=True, label_width=20)
+        train = mx.io.DetRecordIter(
+            path_imgrec=args.data_train, path_imgidx=args.data_idx,
+            batch_size=args.batch_size, data_shape=(3, 300, 300),
+            scale=1.0 / 255, rand_mirror=True,
+            shuffle=args.data_idx is not None)
         mod = mx.mod.Module(net, data_names=("data",),
                             label_names=("label",),
                             context=get_context(args))
@@ -76,6 +80,8 @@ if __name__ == "__main__":
                 optimizer_params={"learning_rate": args.lr,
                                   "momentum": args.mom, "wd": args.wd},
                 eval_metric=ssd.MultiBoxMetric(),
+                batch_end_callback=mx.callback.Speedometer(
+                    args.batch_size, 20),
                 num_epoch=args.num_epochs)
     else:
         num_classes = 2

@@ -666,3 +666,47 @@ class ImageDetIter(DataIter):
             raise StopIteration
         return DataBatch([nd.array(data)], [nd.array(label)],
                          self.batch_size - n)
+
+
+class DetRecordIter(DataIter):
+    """SSD-style detection feed (reference example/ssd/dataset/iterator.py
+    DetRecordIter): wraps ImageDetIter and reshapes each packed label row
+    to (batch, max_objects, object_width), stripping the [c, h, w, len]
+    size header and the [header_width, object_width] packing header.
+    Module.fit-ready: provide_label is fixed up-front by probing one
+    batch (the reference estimates it on the first batch instead)."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape,
+                 path_imgidx=None, shuffle=False, label_pad_width=-1,
+                 label_name="label", **kwargs):
+        super().__init__()
+        self._iter = ImageDetIter(
+            batch_size=batch_size, data_shape=data_shape,
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+            shuffle=shuffle, label_pad_width=label_pad_width, **kwargs)
+        self.batch_size = batch_size
+        self.label_name = label_name
+        self.provide_data = self._iter.provide_data
+        first = self._iter.next().label[0].asnumpy()
+        self._header_width = int(first[0, 4])
+        self._obj_width = int(first[0, 5])
+        self._start = 4 + self._header_width
+        self._max_obj = (first.shape[1] - self._start) // self._obj_width
+        if self._obj_width < 5:
+            raise MXNetError("object width must be >= 5 (cls + 4 corners)")
+        self.provide_label = [DataDesc(
+            label_name, (batch_size, self._max_obj, self._obj_width))]
+        self._iter.reset()
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        batch = self._iter.next()
+        rows = batch.label[0].asnumpy()
+        end = self._start + self._max_obj * self._obj_width
+        boxes = rows[:, self._start:end].reshape(
+            rows.shape[0], self._max_obj, self._obj_width)
+        return DataBatch(batch.data, [nd.array(boxes)], batch.pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -474,4 +474,13 @@ def ImageDetRecordIter(**kwargs):
     return ImageDetIter(**kwargs)
 
 
+def DetRecordIter(**kwargs):
+    """Module.fit-ready detection feed: ImageDetRecordIter + the SSD
+    label reshape to (batch, max_objects, object_width) (reference
+    example/ssd/dataset/iterator.py DetRecordIter)."""
+    from .image import DetRecordIter as _Det
+
+    return _Det(**kwargs)
+
+
 MXDataIter = DataIter  # reference exposes C-iterator wrapper under this name

@@ -300,11 +300,17 @@ _REGISTRY = {
 
 
 def create(metric, **kwargs):
-    """str name / callable / EvalMetric / list -> EvalMetric."""
-    if callable(metric):
-        return CustomMetric(metric)
+    """str name / callable / EvalMetric / list -> EvalMetric.
+
+    Anything already speaking the metric protocol (update/reset/get —
+    e.g. example-level duck-typed metrics like SSD's MultiBoxMetric)
+    passes through unchanged."""
     if isinstance(metric, EvalMetric):
         return metric
+    if all(hasattr(metric, m) for m in ("update", "reset", "get")):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric)
     if isinstance(metric, list):
         out = CompositeEvalMetric()
         for m in metric:

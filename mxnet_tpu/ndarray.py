@@ -98,6 +98,8 @@ def imperative_invoke(opdef, inputs, attrs, out=None):
     """
     if isinstance(opdef, str):
         opdef = _registry.get(opdef)
+    if attrs:
+        opdef.check_call_attrs(attrs)  # typo net (dmlc::Parameter analog)
     attrs = opdef.canon_attrs(attrs)
     is_train = _autograd.is_training()
     rng = _random.next_key() if opdef.needs_rng else None
@@ -674,7 +676,7 @@ def _make_ndarray_function(opdef):
         return result
 
     fn.__name__ = opdef.name
-    fn.__doc__ = "Auto-generated NDArray function for op %s" % opdef.name
+    fn.__doc__ = opdef.docstring()
     return fn
 
 

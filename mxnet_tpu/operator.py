@@ -324,6 +324,7 @@ class _CustomOpDef(OpDef):
             _custom_fcompute,
             arguments=("data",),
             defaults={},
+            open_attrs=True,  # kwargs flow to the user's CustomOpProp
         )
 
     def canon_attrs(self, raw_attrs):

@@ -43,6 +43,7 @@ class OpDef:
         visible=True,
         needs_rng=False,
         mutate_inputs=(),
+        open_attrs=False,
     ):
         self.name = name
         self.fcompute = fcompute
@@ -74,6 +75,9 @@ class OpDef:
         # sgd_mom_update's momentum). fcompute returns the updated values as
         # extra trailing outputs; the imperative layer writes them back.
         self.mutate_inputs = tuple(mutate_inputs)
+        # ops forwarding arbitrary kwargs to user code (Custom): the
+        # typo net cannot know their parameter space
+        self.open_attrs = open_attrs
 
     # -- attr handling ------------------------------------------------------
     def canon_attrs(self, raw_attrs):
@@ -84,6 +88,97 @@ class OpDef:
                 continue
             attrs[k] = parse_attr_value(v)
         return attrs
+
+    # graph/scope attrs every op silently carries (AttrScope, placement,
+    # display); never operator parameters
+    _GENERIC_ATTRS = frozenset({"ctx_group", "lr_mult", "wd_mult",
+                                "force_mirroring"})
+
+    def known_attrs(self):
+        """Over-approximate set of parameter names this op accepts:
+        declared defaults ∪ every attrs.get("x")/attrs["x"] key in the
+        fcompute/infer sources AND the same-module helpers they call
+        (Convolution reads its dims inside _conv_dims) — the
+        dmlc::Parameter field-list analog, recovered rather than
+        declared. Used to flag typo'd kwargs. Returns None (cached) when
+        any source is uninspectable."""
+        cached = getattr(self, "_known_attrs", "unset")
+        if cached != "unset":
+            return cached or None  # False sentinel -> None
+        import inspect
+        import re
+
+        keys = set(self.defaults) | self._GENERIC_ATTRS
+        if self.key_var_num_args:
+            keys.add(self.key_var_num_args)
+        seen = set()
+        queue = [fn for fn in (self.fcompute, self._infer_shape,
+                               self._infer_type, self.backward_infer_shape)
+                 if fn is not None]
+        depth = 0
+        while queue and depth < 64:
+            fn = queue.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            depth += 1
+            try:
+                src = inspect.getsource(fn)
+            except (OSError, TypeError):
+                # builtins/lambda-in-repl: cannot introspect — accept all
+                self._known_attrs = False
+                return None
+            keys.update(re.findall(
+                r"""attrs\s*(?:\.get\(\s*|\[\s*)["']([A-Za-z_][\w]*)""",
+                src))
+            # follow helpers that are handed the attrs dict
+            # (e.g. "_conv_dims(attrs)") so delegated reads count too
+            for callee in re.findall(r"(\w+)\s*\(\s*attrs\b", src):
+                target = getattr(fn, "__globals__", {}).get(callee)
+                if inspect.isfunction(target):
+                    queue.append(target)
+        self._known_attrs = frozenset(keys)
+        return self._known_attrs
+
+    def check_call_attrs(self, attrs):
+        """Warn on kwargs the op cannot possibly read — the typo net the
+        reference gets from dmlc::Parameter's strict field parsing."""
+        if self.open_attrs:
+            return
+        known = self.known_attrs()
+        if known is None:
+            return
+        unknown = [k for k in attrs
+                   if not k.startswith("__") and k not in known]
+        if unknown:
+            import warnings
+
+            suggest = sorted(k for k in known
+                             if not k.startswith("__")
+                             and k not in self._GENERIC_ATTRS)
+            warnings.warn(
+                "%s: parameter(s) %s not recognized by this operator "
+                "(typo?) — accepted: %s"
+                % (self.name, sorted(unknown), suggest),
+                stacklevel=4)
+
+    def docstring(self):
+        """Generated operator doc (parity: MXSymbolGetAtomicSymbolInfo's
+        dmlc::Parameter docgen feeding the python op factories)."""
+        lines = ["%s(%s, **params)" % (
+            self.name, ", ".join(self._arguments)), ""]
+        if self.defaults:
+            lines.append("Parameters (with defaults):")
+            for k in sorted(self.defaults):
+                lines.append("    %s = %r" % (k, self.defaults[k]))
+        if self._aux:
+            lines.append("Auxiliary states: %s" % ", ".join(self._aux))
+        if self.aliases:
+            lines.append("Aliases: %s" % ", ".join(self.aliases))
+        lines.append("")
+        lines.append("Auto-generated from the operator registry "
+                     "(see mxnet_tpu/ops).")
+        return "\n".join(lines)
 
     # -- arity --------------------------------------------------------------
     def num_inputs(self, attrs):

@@ -580,6 +580,7 @@ def load(fname):
 # --------------------------------------------------------------------------
 def _create_symbol(op_name, sym_inputs, attrs, name=None, attr=None):
     opdef = _registry.get(op_name)
+    opdef.check_call_attrs(attrs)  # typo net (dmlc::Parameter analog)
     canon = opdef.canon_attrs(attrs)
     hint = opdef.name.lower().lstrip("_")
     name = NameManager.current().get(name, hint)
@@ -652,7 +653,7 @@ def _make_symbol_function(opdef):
         return _create_symbol(opdef.name, sym_inputs, attrs, name=name, attr=attr)
 
     fn.__name__ = opdef.name
-    fn.__doc__ = "Auto-generated Symbol function for op %s" % opdef.name
+    fn.__doc__ = opdef.docstring()
     return fn
 
 

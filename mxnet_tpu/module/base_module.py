@@ -1,36 +1,44 @@
 """BaseModule: the abstract training-loop interface.
 
-Parity: reference ``python/mxnet/module/base_module.py`` (952 LoC) — the
-``fit`` loop (base_module.py:368-516), ``score``/``predict``/
-``iter_predict``, parameter accessors, and the forward_backward contract.
+Capability parity with reference ``python/mxnet/module/base_module.py``
+— the ``fit`` loop (base_module.py:368-516), ``score``/``predict``/
+``iter_predict``, parameter accessors, and the forward_backward
+contract. Re-authored around three shared helpers: a callback firer, an
+inference-batch generator (forward + pad handling in one place), and a
+param-file codec, instead of the reference's per-method inline loops.
 """
 from __future__ import annotations
 
 import logging
 import time
 
-import numpy as np
-
 from .. import metric as metric_mod
 from .. import ndarray as nd
-from ..base import MXNetError
 from ..initializer import Uniform
 from ..model import BatchEndParam
-from ..io import DataDesc
+from ..io import DataDesc  # noqa: F401  (re-exported for subclasses)
 
 
 def _as_list(obj):
-    if isinstance(obj, list):
-        return obj
-    return [obj]
+    return obj if isinstance(obj, list) else [obj]
+
+
+def _fire(callbacks, epoch, nbatch, eval_metric, local_vars):
+    """Invoke batch/epoch callbacks with the reference's BatchEndParam."""
+    if callbacks is None:
+        return
+    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                           eval_metric=eval_metric, locals=local_vars)
+    for cb in _as_list(callbacks):
+        cb(params)
 
 
 def _check_input_names(symbol, names, typename, throw):
     args = symbol.list_arguments()
-    for name in names:
-        if name in args:
-            continue
-        candidates = [arg for arg in args if not arg.endswith(("_weight", "_bias", "_gamma", "_beta"))]
+    missing = [n for n in names if n not in args]
+    for name in missing:
+        candidates = [a for a in args if not a.endswith(
+            ("_weight", "_bias", "_gamma", "_beta"))]
         msg = (
             "\033[91mYou created Module with Module(..., %s_names=%s) but "
             "input with name '%s' is not found in symbol.list_arguments(). "
@@ -54,92 +62,76 @@ class BaseModule(object):
         self._total_exec_bytes = 0
 
     # ------------------------------------------------------------------
+    # shared inference plumbing
+    # ------------------------------------------------------------------
+    def _infer_batches(self, eval_data, num_batch, reset,
+                       want_outputs=True):
+        """Yield (nbatch, batch, unpadded outputs) over an eval iter.
+        Metric-only consumers pass want_outputs=False so the (possibly
+        multi-device) output gather is skipped entirely."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                return
+            self.forward(batch, is_train=False)
+            if want_outputs:
+                pad = batch.pad or 0
+                outs = [out[0:out.shape[0] - pad]
+                        for out in self.get_outputs()]
+            else:
+                outs = None
+            yield nbatch, batch, outs
+
+    # ------------------------------------------------------------------
     # high-level
     # ------------------------------------------------------------------
     def forward_backward(self, data_batch):
-        """Parity base_module.py:191."""
         self.forward(data_batch, is_train=True)
         self.backward()
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        """Parity base_module.py:214."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        """Run inference over eval_data, accumulating eval_metric."""
+        eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(
-                    epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                    locals=locals()
-                )
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(
-                epoch=epoch, nbatch=actual_num_batch, eval_metric=eval_metric,
-                locals=locals()
-            )
-            for callback in _as_list(score_end_callback):
-                callback(params)
+        n_seen = 0
+        for nbatch, batch, _outs in self._infer_batches(
+                eval_data, num_batch, reset, want_outputs=False):
+            self.update_metric(eval_metric, batch.label)
+            _fire(batch_end_callback, epoch, nbatch, eval_metric, locals())
+            n_seen = nbatch + 1
+        _fire(score_end_callback, epoch, n_seen, eval_metric, locals())
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        """Parity base_module.py:272."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0 : out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        """Generator over (outputs, nbatch, batch) for streaming predict."""
+        for nbatch, batch, outs in self._infer_batches(
+                eval_data, num_batch, reset):
+            yield outs, nbatch, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        """Parity base_module.py:303."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [
-                out[0 : out.shape[0] - pad].copy() for out in self.get_outputs()
-            ]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, (
-                    "Cannot merge batches, as num of outputs is not the same "
-                    "in mini-batches. Maybe bucketing is used?"
-                )
-            output_list2 = [
-                nd.concatenate([out[i] for out in output_list])
-                for i in range(num_outputs)
-            ]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        """Predict over an iterator; merged across batches by default."""
+        collected = [
+            [o.copy() for o in outs]
+            for _n, _b, outs in self._infer_batches(eval_data, num_batch,
+                                                    reset)
+        ]
+        if not collected or not merge_batches:
+            return collected
+        arity = len(collected[0])
+        if any(len(outs) != arity for outs in collected):
+            raise AssertionError(
+                "Cannot merge batches, as num of outputs is not the same "
+                "in mini-batches. Maybe bucketing is used?")
+        merged = [nd.concatenate([outs[i] for outs in collected])
+                  for i in range(arity)]
+        if arity == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -167,14 +159,10 @@ class BaseModule(object):
             kvstore=kvstore, optimizer=optimizer,
             optimizer_params=optimizer_params
         )
+        eval_metric = metric_mod.create(eval_metric)
         if validation_metric is None:
             validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
 
-        ################################################################
-        # training loop
-        ################################################################
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -186,30 +174,21 @@ class BaseModule(object):
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals()
-                    )
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
+                _fire(batch_end_callback, epoch, nbatch, eval_metric,
+                      locals())
 
-            # one epoch of training is finished
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f",
+                             epoch, time.time() - tic)
 
-            # sync aux params across devices
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
+            # sync params (and multi-device aux) back to the host copies
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
             if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_now, aux_now)
 
-            # ----------------------------------------
-            # evaluation on validation set
             if eval_data:
                 res = self.score(
                     eval_data, validation_metric,
@@ -217,9 +196,9 @@ class BaseModule(object):
                     batch_end_callback=eval_batch_end_callback, epoch=epoch
                 )
                 for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
 
-            # end of 1 epoch, reset the data-iter for another epoch
             train_data.reset()
 
     # ------------------------------------------------------------------
@@ -265,23 +244,18 @@ class BaseModule(object):
 
     def save_params(self, fname):
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        blob = {"arg:" + k: v for k, v in arg_params.items()}
+        blob.update({"aux:" + k: v for k, v in aux_params.items()})
+        nd.save(fname, blob)
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
+        split = {"arg": {}, "aux": {}}
+        for key, value in nd.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind not in split or not name:
                 raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
+            split[kind][name] = value
+        self.set_params(split["arg"], split["aux"])
 
     # ------------------------------------------------------------------
     # computation interface

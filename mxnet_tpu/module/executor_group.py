@@ -61,11 +61,19 @@ def _load_label(batch, targets):
 
 def _merge_multi_context(outputs):
     """Concatenate per-device outputs along batch (parity
-    executor_group.py:52 _merge_multi_context with axis 0)."""
-    return [
-        nd.concatenate(tensors, axis=0) if len(tensors) > 1 else tensors[0]
-        for tensors in outputs
-    ]
+    executor_group.py:52 _merge_multi_context with axis 0). Shards are
+    committed to their executor's device, so they must be gathered onto
+    one device first — jax refuses cross-committed-device concatenation
+    (the reference copies into one pinned-CPU output for the same
+    reason)."""
+    def _gather(tensors):
+        if len(tensors) == 1:
+            return tensors[0]
+        home = tensors[0].context
+        return nd.concatenate(
+            [t.as_in_context(home) for t in tensors], axis=0)
+
+    return [_gather(tensors) for tensors in outputs]
 
 
 class DataParallelExecutorGroup(object):

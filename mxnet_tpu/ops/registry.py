@@ -131,9 +131,10 @@ class OpDef:
             keys.update(re.findall(
                 r"""attrs\s*(?:\.get\(\s*|\[\s*)["']([A-Za-z_][\w]*)""",
                 src))
-            # follow helpers that are handed the attrs dict
-            # (e.g. "_conv_dims(attrs)") so delegated reads count too
-            for callee in re.findall(r"(\w+)\s*\(\s*attrs\b", src):
+            # follow helpers that are handed the attrs dict in ANY
+            # argument position ("_conv_dims(attrs)", "_prep(w, g, attrs)")
+            # so delegated reads count too
+            for callee in re.findall(r"(\w+)\s*\([^()]*\battrs\b", src):
                 target = getattr(fn, "__globals__", {}).get(callee)
                 if inspect.isfunction(target):
                     queue.append(target)

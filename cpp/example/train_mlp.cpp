@@ -1,0 +1,196 @@
+// train_mlp.cpp — end-to-end training from the C++ frontend.
+//
+// C++ analog of the reference's cpp-package/example/mlp.cpp /
+// lenet.cpp: build a Symbol with Operator, SimpleBind an Executor,
+// drive the SGD Optimizer per parameter, score with Accuracy, and
+// round-trip a checkpoint. Data: sklearn's bundled handwritten digits
+// (offline, same set the python train-tier convergence gates use —
+// tests/test_train_convergence.py).
+//
+// Usage: train_mlp [--cpu]    (--cpu routes JAX onto the host platform;
+//                              default grabs the accelerator plugin)
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "mxtpu/mxtpu.hpp"
+
+using namespace mxtpu;  // NOLINT
+
+namespace {
+
+Symbol BuildMLP() {
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("softmax_label");
+  Symbol fc1 = Operator("FullyConnected")
+                   .SetParam("num_hidden", 64)(data)
+                   .CreateSymbol("fc1");
+  Symbol act1 =
+      Operator("Activation").SetParam("act_type", "relu")(fc1).CreateSymbol(
+          "relu1");
+  Symbol fc2 = Operator("FullyConnected")
+                   .SetParam("num_hidden", 32)(act1)
+                   .CreateSymbol("fc2");
+  Symbol act2 =
+      Operator("Activation").SetParam("act_type", "relu")(fc2).CreateSymbol(
+          "relu2");
+  Symbol fc3 = Operator("FullyConnected")
+                   .SetParam("num_hidden", 10)(act2)
+                   .CreateSymbol("fc3");
+  return Operator("SoftmaxOutput")
+      .SetInput("data", fc3)
+      .SetInput("label", label)
+      .CreateSymbol("softmax");
+}
+
+// Load the 1797x64 digits set through the embedded interpreter.
+void LoadDigits(std::vector<float>* X, std::vector<float>* y, size_t* n) {
+  Obj skl = Obj::Steal(PyImport_ImportModule("sklearn.datasets"),
+                       "import sklearn.datasets");
+  Obj ds = skl.attr("load_digits")();
+  Obj np = Runtime::Get().np();
+  Obj Xn = ds.attr("data")
+               .attr("astype")(to_py("float32"))
+               .attr("__truediv__")(to_py(16.0));
+  Obj yn = ds.attr("target").attr("astype")(to_py("float32"));
+  auto to_vec = [](const Obj& arr, std::vector<float>* out) {
+    Obj b = arr.attr("astype")(to_py("float32")).attr("tobytes")();
+    char* src = nullptr;
+    Py_ssize_t nb = 0;
+    PyBytes_AsStringAndSize(b.get(), &src, &nb);
+    out->resize(static_cast<size_t>(nb) / sizeof(float));
+    std::memcpy(out->data(), src, static_cast<size_t>(nb));
+  };
+  to_vec(Xn, X);
+  to_vec(yn, y);
+  *n = y->size();
+  (void)np;
+}
+
+// Copy trained weights into another executor bound to the same symbol.
+void ShareWeights(const std::map<std::string, NDArray>& src, Executor* dst) {
+  auto dargs = dst->arg_dict();
+  for (const auto& kv : src)
+    if (kv.first != "data" && kv.first != "softmax_label")
+      kv.second.CopyTo(&dargs[kv.first]);
+}
+
+float Evaluate(Executor* exec, const NDArray& data, const NDArray& label) {
+  auto args = exec->arg_dict();
+  data.CopyTo(&args["data"]);
+  exec->Forward(false);
+  Accuracy acc;
+  acc.Update(label, exec->outputs[0]);
+  return acc.Get();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc; ++i)
+    if (std::string(argv[i]) == "--cpu") Runtime::UsePlatform("cpu");
+
+  const int batch = 100;
+  const int epochs = 40;
+  Context ctx = Context::cpu();
+
+  Symbol net = BuildMLP();
+
+  std::vector<float> X, y;
+  size_t n = 0;
+  LoadDigits(&X, &y, &n);
+  const size_t train_n = 1500, dim = 64;
+  const size_t val_n = n - train_n;
+  NDArray train_x(X.data(), train_n * dim, Shape{train_n, dim}, ctx);
+  NDArray train_y(y.data(), train_n, Shape{train_n}, ctx);
+  NDArray val_x(X.data() + train_n * dim, val_n * dim, Shape{val_n, dim}, ctx);
+  NDArray val_y(y.data() + train_n, val_n, Shape{val_n}, ctx);
+
+  // Training executor at `batch`, validation executor at full val size.
+  std::map<std::string, NDArray> args_map = {
+      {"data", NDArray(Shape{(size_t)batch, dim}, ctx)},
+      {"softmax_label", NDArray(Shape{(size_t)batch}, ctx)},
+  };
+  Executor* exec = net.SimpleBind(ctx, args_map);
+  std::map<std::string, NDArray> val_args = {
+      {"data", NDArray(Shape{val_n, dim}, ctx)},
+      {"softmax_label", NDArray(Shape{val_n}, ctx)},
+  };
+  Executor* val_exec = net.SimpleBind(ctx, val_args, "null");
+
+  // Initialize parameters in place.
+  Xavier xavier("gaussian", "in", 2.0);
+  Zero zero;
+  auto args = exec->arg_dict();
+  for (auto& kv : args) {
+    if (kv.first == "data" || kv.first == "softmax_label") continue;
+    if (kv.first.find("bias") != std::string::npos)
+      zero(kv.first, &kv.second);
+    else
+      xavier(kv.first, &kv.second);
+  }
+
+  Optimizer* opt = Optimizer::Find("sgd");
+  opt->SetParam("learning_rate", 0.2)
+      .SetParam("momentum", 0.9)
+      .SetParam("wd", 1e-4)
+      .SetParam("rescale_grad", 1.0 / batch);
+
+  NDArrayIter train_iter(train_x, train_y, batch, /*shuffle=*/true);
+  auto grads = exec->grad_dict();
+
+  for (int epoch = 0; epoch < epochs; ++epoch) {
+    train_iter.Reset();
+    while (train_iter.Next()) {
+      train_iter.GetData().CopyTo(&args["data"]);
+      train_iter.GetLabel().CopyTo(&args["softmax_label"]);
+      exec->Forward(true);
+      exec->Backward();
+      int index = 0;
+      for (auto& kv : args) {
+        if (kv.first == "data" || kv.first == "softmax_label") {
+          ++index;
+          continue;
+        }
+        opt->Update(index++, kv.second, grads[kv.first]);
+      }
+    }
+    if ((epoch + 1) % 10 == 0) {
+      ShareWeights(args, val_exec);
+      std::printf("epoch %d val-accuracy: %.4f\n", epoch + 1,
+                  Evaluate(val_exec, val_x, val_y));
+    }
+  }
+
+  // Final validation score.
+  ShareWeights(args, val_exec);
+  float final_acc = Evaluate(val_exec, val_x, val_y);
+  std::printf("final-accuracy: %.4f\n", final_acc);
+
+  // Checkpoint round-trip through the dmlc-compatible .params container.
+  std::map<std::string, NDArray> to_save;
+  for (auto& kv : args)
+    if (kv.first != "data" && kv.first != "softmax_label")
+      to_save["arg:" + kv.first] = kv.second;
+  NDArray::Save("/tmp/mxtpu_cpp_mlp.params", to_save);
+  net.Save("/tmp/mxtpu_cpp_mlp-symbol.json");
+
+  Symbol net2 = Symbol::Load("/tmp/mxtpu_cpp_mlp-symbol.json");
+  Executor* reload_exec = net2.SimpleBind(ctx, val_args, "null");
+  auto loaded = NDArray::LoadToMap("/tmp/mxtpu_cpp_mlp.params");
+  auto rargs = reload_exec->arg_dict();
+  for (auto& kv : loaded) {
+    std::string name = kv.first.substr(4);  // strip "arg:"
+    kv.second.CopyTo(&rargs[name]);
+  }
+  float reload_acc = Evaluate(reload_exec, val_x, val_y);
+  std::printf("reload-accuracy: %.4f\n", reload_acc);
+  std::printf("checkpoint-roundtrip: %s\n",
+              (reload_acc == final_acc) ? "exact" : "MISMATCH");
+
+  delete exec;
+  delete val_exec;
+  delete reload_exec;
+  return (final_acc > 0.90f && reload_acc == final_acc) ? 0 : 1;
+}

@@ -137,7 +137,9 @@ class Obj {
 
   std::string str() const {
     Obj s = Steal(PyObject_Str(p_), "str");
-    return PyUnicode_AsUTF8(s.get());
+    const char* c = PyUnicode_AsUTF8(s.get());
+    if (c == nullptr) ThrowPythonError("str");
+    return c;
   }
 
  private:
@@ -711,8 +713,13 @@ class Executor {
     // backward() can run forward+backward as one fused XLA executable
     // (mxnet_tpu/executor.py forward/backward); touching .outputs here
     // would force an extra forward-only launch, so refresh only on the
-    // inference path — Backward() refreshes for the training path.
-    if (!is_train) RefreshOutputs();
+    // inference path — Backward() refreshes for the training path, and
+    // Outputs() materializes on demand in between. Clearing prevents a
+    // stale previous-step read through the public member.
+    if (is_train)
+      outputs.clear();
+    else
+      RefreshOutputs();
   }
   void Backward(const std::vector<NDArray>& head_grads = {}) {
     if (head_grads.empty()) {
@@ -740,8 +747,20 @@ class Executor {
 
   const Obj& py() const { return h_; }
 
-  // Valid after Forward(false) or Backward(); empty before the first run
-  // (mirrors the reference's public `outputs` member, executor.h).
+  // On-demand outputs: always valid. After Forward(true) this
+  // materializes a forward-only launch from the stashed inputs (same
+  // semantics as reading .outputs before backward() in python) — so
+  // reference-ported loops that score right after a training forward
+  // are correct, while loops that go Forward(true)->Backward() keep the
+  // single fused fwd+bwd launch.
+  const std::vector<NDArray>& Outputs() {
+    if (outputs.empty()) RefreshOutputs();
+    return outputs;
+  }
+
+  // Valid after Forward(false), Backward(), or Outputs(); empty right
+  // after Forward(true) (the launch is deferred — use Outputs() if you
+  // need them there). Mirrors the reference's public member, executor.h.
   std::vector<NDArray> outputs;
 
  private:

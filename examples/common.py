@@ -35,6 +35,8 @@ def add_fit_args(parser):
     """Parity common/fit.py:45."""
     parser.add_argument("--network", type=str, default=None)
     parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--num-group", type=int, default=32,
+                        help="resnext cardinality")
     parser.add_argument("--ctx", type=str, default="tpu",
                         choices=["tpu", "cpu", "gpu"])
     parser.add_argument("--num-devices", type=int, default=1)

@@ -25,8 +25,21 @@ def get_symbol(args):
         from mxnet_tpu.models.resnet import get_symbol as f
         return f(num_classes=args.num_classes,
                  num_layers=args.num_layers, dtype=args.dtype)
+    if name == "resnext":
+        from mxnet_tpu.models.resnext import get_symbol as f
+        return f(num_classes=args.num_classes,
+                 num_layers=args.num_layers,
+                 num_group=args.num_group,
+                 image_shape=args.image_shape)
     if name == "inception-v3":
         from mxnet_tpu.models.inception_v3 import get_symbol as f
+        return f(num_classes=args.num_classes)
+    if name == "inception-bn":
+        from mxnet_tpu.models.inception_bn import get_symbol as f
+        return f(num_classes=args.num_classes,
+                 image_shape=args.image_shape)
+    if name == "googlenet":
+        from mxnet_tpu.models.googlenet import get_symbol as f
         return f(num_classes=args.num_classes)
     if name == "vgg":
         from mxnet_tpu.models.vgg import get_symbol as f

@@ -11,6 +11,9 @@ from .lenet import get_symbol as lenet
 from .alexnet import get_symbol as alexnet
 from .resnet import get_symbol as resnet
 from .inception_v3 import get_symbol as inception_v3
+from .inception_bn import get_symbol as inception_bn
+from .googlenet import get_symbol as googlenet
+from .resnext import get_symbol as resnext
 from .vgg import get_symbol as vgg
 from .lstm import lstm_unroll, BucketingLSTMModel
 from .transformer import transformer_lm

@@ -1,0 +1,85 @@
+"""Model-zoo structural gates (reference analog: symbols/*.py are
+exercised by example trainings + test_forward goldens; here each zoo
+builder must infer shapes end to end and the new families must take a
+real optimizer step).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize(
+    "builder,kwargs,n_args_min",
+    [
+        (models.alexnet, {}, 10),
+        (models.vgg, {}, 10),
+        (models.resnet, {"num_layers": 18}, 40),
+        (models.resnet, {"num_layers": 50}, 100),
+        (models.resnext, {"num_layers": 50}, 100),
+        (models.resnext, {"num_layers": 101, "num_group": 64,
+                          "bottleneck_width": 1.0}, 200),
+        (models.inception_v3, {}, 90),
+        (models.inception_bn, {}, 60),
+        (models.googlenet, {}, 50),
+    ],
+)
+def test_zoo_shapes(builder, kwargs, n_args_min):
+    num_classes = 1000
+    sym = builder(num_classes=num_classes, **kwargs)
+    shape = (2, 3, 299, 299) if builder is models.inception_v3 \
+        else (2, 3, 224, 224)
+    args, outs, _ = sym.infer_shape(data=shape, softmax_label=(2,))
+    assert outs == [(2, num_classes)]
+    assert len(sym.list_arguments()) >= n_args_min
+    # every parameter got a concrete shape
+    assert all(all(d > 0 for d in s) for s in args)
+
+
+def test_grouped_convolution_matches_per_group():
+    """ResNeXt's cardinality path: num_group=G must equal running G
+    independent convs over channel slices and concatenating."""
+    rng = np.random.RandomState(0)
+    G, cin, cout = 4, 8, 12
+    x = rng.randn(2, cin, 9, 9).astype(np.float32)
+    w = rng.randn(cout, cin // G, 3, 3).astype(np.float32)
+    out = mx.nd.Convolution(
+        mx.nd.array(x), mx.nd.array(w), num_filter=cout, kernel=(3, 3),
+        pad=(1, 1), num_group=G, no_bias=True)
+    pieces = []
+    for g in range(G):
+        xg = x[:, g * (cin // G):(g + 1) * (cin // G)]
+        wg = w[g * (cout // G):(g + 1) * (cout // G)]
+        pieces.append(mx.nd.Convolution(
+            mx.nd.array(xg), mx.nd.array(wg), num_filter=cout // G,
+            kernel=(3, 3), pad=(1, 1), no_bias=True).asnumpy())
+    np.testing.assert_allclose(out.asnumpy(), np.concatenate(pieces, 1),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "sym,shape",
+    [
+        (models.inception_bn(num_classes=10, image_shape="3,28,28"),
+         (8, 3, 28, 28)),
+        (models.resnext(num_classes=10, num_layers=50, num_group=8,
+                        image_shape="3,32,32"),
+         (8, 3, 32, 32)),
+    ],
+    ids=["inception_bn_small", "resnext_cifar"],
+)
+def test_new_families_take_a_training_step(sym, shape):
+    ex = sym.simple_bind(ctx=mx.cpu(), data=shape, softmax_label=(shape[0],))
+    rng = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = (rng.randn(*arr.shape) * 0.05).astype(np.float32)
+    ex.arg_dict["data"][:] = rng.rand(*shape).astype(np.float32)
+    ex.arg_dict["softmax_label"][:] = rng.randint(
+        0, 10, shape[0]).astype(np.float32)
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["fc1_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0

@@ -1,0 +1,93 @@
+"""Worker liveness: heartbeats + dead-node detection + watchdog support.
+
+Capability parity, SURVEY.md §5.3: the reference's ps-lite Van sends
+heartbeats to the scheduler and surfaces stale peers through
+``KVStore::get_num_dead_node(node_id, timeout)`` (kvstore.h:235-244).
+The TPU build has no scheduler process — ICI/DCN collectives are the
+comm fabric — so liveness runs over the one medium every launcher
+already shares with its workers: the run directory. Each worker's
+``HeartbeatWriter`` daemon thread touches ``hb_<rank>`` every
+``interval`` seconds; any process (a peer's kvstore, the watchdog, an
+operator's shell) can then read staleness with ``dead_nodes``. This is
+deliberately not a collective: liveness checks must keep working
+exactly when collectives hang.
+
+``tools/launch.py`` exports ``MXTPU_RUN_DIR`` so heartbeats start
+automatically whenever a dist kvstore is created; ``tools/watchdog.py``
+supervises a training command with the same signals (exit code +
+heartbeat staleness) and restarts it from its checkpoints.
+"""
+import os
+import threading
+import time
+
+RUN_DIR_ENV = "MXTPU_RUN_DIR"
+_HB_PREFIX = "hb_"
+
+
+def run_dir():
+    """The launcher-provided liveness directory, or None outside a
+    launched job."""
+    return os.environ.get(RUN_DIR_ENV) or None
+
+
+class HeartbeatWriter:
+    """Touch ``<run_dir>/hb_<rank>`` every ``interval`` seconds from a
+    daemon thread (reference analog: Van::Heartbeat thread)."""
+
+    def __init__(self, directory, rank, interval=2.0):
+        self._path = os.path.join(directory, "%s%d" % (_HB_PREFIX, rank))
+        self._interval = float(interval)
+        self._stop = threading.Event()
+        self._thread = None
+        os.makedirs(directory, exist_ok=True)
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._beat()
+        self._thread = threading.Thread(
+            target=self._loop, name="mxtpu-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 1.0)
+            self._thread = None
+
+    def _beat(self):
+        # liveness is the file's mtime (all dead_nodes reads); touch is
+        # cheaper and atomic vs the readers, no payload needed
+        with open(self._path, "a"):
+            pass
+        os.utime(self._path, None)
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._beat()
+            except OSError:
+                # run dir vanished (job teardown) — stop quietly
+                return
+
+
+def dead_nodes(directory, num_workers, timeout=60.0, now=None):
+    """Ranks whose heartbeat is missing or older than ``timeout`` seconds.
+
+    Semantics of ``get_num_dead_node``: a node that never wrote a
+    heartbeat counts as dead (the reference's scheduler likewise treats
+    an unregistered-but-expected node as not alive)."""
+    now = time.time() if now is None else now
+    dead = []
+    for rank in range(int(num_workers)):
+        path = os.path.join(directory, "%s%d" % (_HB_PREFIX, rank))
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            dead.append(rank)
+            continue
+        if age > timeout:
+            dead.append(rank)
+    return dead

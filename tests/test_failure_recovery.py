@@ -1,0 +1,175 @@
+"""Failure detection + recovery (SURVEY.md §5.3).
+
+Reference level: ps-lite heartbeats surfaced as
+``KVStore::get_num_dead_node`` (kvstore.h:235-244) and checkpoint/resume
+by hand. This build reproduces the detection surface over the launcher
+run dir (parallel/heartbeat.py) and goes one step further with
+tools/watchdog.py: crash AND hang detection with checkpoint-based
+auto-restart, proven here by fault injection.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import heartbeat as hb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import watchdog  # noqa: E402
+
+
+def test_heartbeat_dead_node_detection(tmp_path):
+    d = str(tmp_path)
+    w0 = hb.HeartbeatWriter(d, 0, interval=0.2).start()
+    w1 = hb.HeartbeatWriter(d, 1, interval=0.2).start()
+    try:
+        time.sleep(0.3)
+        # rank 2 never started -> dead; 0 and 1 alive
+        assert hb.dead_nodes(d, 3, timeout=5.0) == [2]
+        # age rank 1 out deterministically (no reliance on thread timing)
+        w1.stop()
+        old = time.time() - 120
+        os.utime(os.path.join(d, "hb_1"), (old, old))
+        assert hb.dead_nodes(d, 3, timeout=30.0) == [1, 2]
+        assert hb.dead_nodes(d, 1, timeout=30.0) == []
+    finally:
+        w0.stop()
+        w1.stop()
+
+
+def test_kvstore_reports_dead_nodes(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv(hb.RUN_DIR_ENV, d)
+    monkeypatch.setenv("DMLC_NUM_WORKER", "3")
+    kv = mx.kvstore.create("local")
+    assert kv.num_workers == 3
+    hb.HeartbeatWriter(d, 0).start().stop()
+    hb.HeartbeatWriter(d, 1).start().stop()
+    # rank 2 missing entirely
+    assert kv.get_num_dead_node(0, timeout=60) == 1
+    # age everyone out
+    old = time.time() - 120
+    for r in (0, 1):
+        os.utime(os.path.join(d, "hb_%d" % r), (old, old))
+    assert kv.get_num_dead_node(0, timeout=60) == 3
+
+
+def test_find_latest_checkpoint(tmp_path):
+    prefix = str(tmp_path / "model")
+    assert watchdog.find_latest_checkpoint(prefix) is None
+    for e in (1, 2, 10):
+        open("%s-%04d.params" % (prefix, e), "w").close()
+    assert watchdog.find_latest_checkpoint(prefix) == 10
+
+
+TRAIN_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %(repo)r)
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    sys.path.insert(0, os.path.join(%(repo)r, "tools"))
+    from watchdog import find_latest_checkpoint
+
+    prefix, fault_flag = sys.argv[1], sys.argv[2]
+    num_epoch = 4
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 16).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    last = find_latest_checkpoint(prefix)
+    begin = 0
+    if last is not None:
+        # resume exactly where the crashed run left off
+        lsym, args, auxs = mx.model.load_checkpoint(prefix, last)
+        mod = mx.mod.Module(lsym, context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.set_params(args, auxs)
+        begin = last
+
+    def crash_mid_training(epoch, *_):
+        # fault injection: die once, after epoch 1's checkpoint
+        if epoch == 1 and not os.path.exists(fault_flag):
+            open(fault_flag, "w").close()
+            os._exit(17)
+
+    mod.fit(it, num_epoch=num_epoch, begin_epoch=begin,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=[mx.callback.do_checkpoint(prefix),
+                                crash_mid_training])
+    print("TRAIN-DONE", flush=True)
+""")
+
+
+def test_watchdog_restarts_crashed_training(tmp_path):
+    script = tmp_path / "train.py"
+    prefix = str(tmp_path / "ckpt")
+    flag = str(tmp_path / "crashed_once")
+    script.write_text(TRAIN_SCRIPT % {"repo": REPO})
+
+    logs = []
+    rc = watchdog.supervise(
+        [sys.executable, str(script), prefix, flag],
+        max_restarts=2, log=logs.append)
+    assert rc == 0
+    assert os.path.exists(flag), "fault was never injected"
+    assert watchdog.find_latest_checkpoint(prefix) == 4
+    assert any("restart 1/2" in m for m in logs), logs
+
+
+def test_watchdog_startup_deadline(tmp_path):
+    """A rank wedged BEFORE its first heartbeat (e.g. stuck distributed
+    init) must trip the startup deadline, not hang the watchdog."""
+    script = tmp_path / "wedge.py"
+    flag = str(tmp_path / "wedged_once")
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        flag = sys.argv[1]
+        if os.path.exists(flag):
+            sys.exit(0)          # second attempt: healthy
+        open(flag, "w").close()
+        time.sleep(600)          # never heartbeats
+    """))
+    rc = watchdog.supervise(
+        [sys.executable, str(script), flag],
+        max_restarts=1, num_workers=1, heartbeat_timeout=60.0,
+        poll_interval=0.3, startup_timeout=2.0,
+        run_dir=str(tmp_path / "run"), log=lambda *_: None)
+    assert rc == 0
+
+
+def test_watchdog_kills_hung_job(tmp_path):
+    """Hang detection: a worker that stops heartbeating gets killed and
+    the job restarted — exit codes alone can never catch this."""
+    script = tmp_path / "hang.py"
+    flag = str(tmp_path / "hung_once")
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        flag = sys.argv[1]
+        d = os.environ["MXTPU_RUN_DIR"]
+        open(os.path.join(d, "hb_0"), "w").close()
+        if os.path.exists(flag):
+            sys.exit(0)          # second attempt: healthy
+        open(flag, "w").close()
+        time.sleep(600)          # first attempt: beat once, then hang
+    """))
+    t0 = time.time()
+    rc = watchdog.supervise(
+        [sys.executable, str(script), flag],
+        max_restarts=1, num_workers=1, heartbeat_timeout=3.0,
+        poll_interval=0.3, run_dir=str(tmp_path / "run"), log=lambda *_: None)
+    assert rc == 0
+    assert time.time() - t0 < 120

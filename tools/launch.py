@@ -32,10 +32,16 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
+import time
 
 
-def worker_env(rank, num_workers, coordinator):
+def worker_env(rank, num_workers, coordinator, run_dir=None):
     env = dict(os.environ)
+    if run_dir:
+        # liveness directory: workers heartbeat here, watchdogs/peers
+        # read staleness (mxnet_tpu/parallel/heartbeat.py)
+        env["MXTPU_RUN_DIR"] = run_dir
     env.update({
         # JAX distributed-runtime contract
         "JAX_PROCESS_ID": str(rank),
@@ -52,14 +58,29 @@ def worker_env(rank, num_workers, coordinator):
 
 def launch_local(num_workers, command, coordinator_port=29500):
     coordinator = "127.0.0.1:%d" % coordinator_port
+    # honor a supervisor-provided liveness dir (tools/watchdog.py sets
+    # MXTPU_RUN_DIR and polls it for stalls) — only mint our own when
+    # running standalone
+    run_dir = os.environ.get("MXTPU_RUN_DIR") or tempfile.mkdtemp(
+        prefix="mxtpu_run_")
     procs = []
     for rank in range(num_workers):
         procs.append(subprocess.Popen(
-            command, env=worker_env(rank, num_workers, coordinator)))
+            command,
+            env=worker_env(rank, num_workers, coordinator, run_dir)))
 
     def _kill(*_):
         for p in procs:
             p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        # fully reaped: a supervisor can relaunch immediately without
+        # racing the old coordinator port
         sys.exit(1)
 
     signal.signal(signal.SIGINT, _kill)

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Supervise a training command: restart on crash or heartbeat stall.
+
+Capability upgrade over the reference (SURVEY.md §5.3: the reference has
+PS heartbeats + ``get_num_dead_node`` but "no checkpoint-based
+auto-restart"): this watchdog closes the loop. It launches the command,
+watches two signals —
+
+  * exit code: nonzero exit triggers a restart (up to --max-restarts);
+  * liveness: with --num-workers N, workers heartbeat into the run dir
+    (mxnet_tpu/parallel/heartbeat.py via MXTPU_RUN_DIR) and a stall
+    longer than --heartbeat-timeout kills and restarts the job — this
+    catches hangs, which exit codes never see.
+
+Recovery itself is the training script's checkpoint/resume contract
+(--model-prefix epoch checkpoints, examples/common.py fit): the command
+is re-run as-is and is expected to pick up its latest checkpoint.
+``find_latest_checkpoint`` is exported for scripts that want automatic
+--load-epoch discovery.
+
+Usage:
+    python tools/watchdog.py --max-restarts 2 -- python train.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def find_latest_checkpoint(prefix):
+    """Latest epoch number among ``<prefix>-NNNN.params``, or None."""
+    best = None
+    for path in glob.glob("%s-*.params" % prefix):
+        m = re.match(r".*-(\d+)\.params$", path)
+        if m:
+            epoch = int(m.group(1))
+            best = epoch if best is None else max(best, epoch)
+    return best
+
+
+def _terminate(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def supervise(command, max_restarts=2, num_workers=0,
+              heartbeat_timeout=60.0, poll_interval=1.0, run_dir=None,
+              startup_timeout=300.0, log=print):
+    """Run ``command`` under supervision; returns the final exit code
+    (0 success, positive failure — signal deaths are normalized to 1 so
+    callers see a stable code).
+
+    ``num_workers > 0`` enables heartbeat-stall detection. Slow startup
+    is not a false positive — staleness only counts once every expected
+    rank has beaten at least once — but a rank that never beats at all
+    (e.g. wedged in distributed init) trips the ``startup_timeout``
+    deadline instead, so pre-first-heartbeat hangs are still caught."""
+    from mxnet_tpu.parallel import heartbeat as hb
+
+    restarts = 0
+    while True:
+        env = dict(os.environ)
+        if num_workers > 0:
+            run_dir = run_dir or tempfile.mkdtemp(prefix="mxtpu_watchdog_")
+            os.makedirs(run_dir, exist_ok=True)
+            # fresh staleness baseline per attempt
+            for p in glob.glob(os.path.join(run_dir, "hb_*")):
+                os.unlink(p)
+            env[hb.RUN_DIR_ENV] = run_dir
+        proc = subprocess.Popen(command, env=env)
+        started_at = time.time()
+        stalled = False
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            if num_workers > 0:
+                all_started = not hb.dead_nodes(
+                    run_dir, num_workers, timeout=float("inf"))
+                if all_started:
+                    stalled = bool(hb.dead_nodes(
+                        run_dir, num_workers, heartbeat_timeout))
+                    reason = "heartbeat stall (> %.0fs)" % heartbeat_timeout
+                else:
+                    stalled = time.time() - started_at > startup_timeout
+                    reason = ("no heartbeat from every rank within "
+                              "%.0fs of start" % startup_timeout)
+                if stalled:
+                    log("[watchdog] %s: killing job" % reason)
+                    _terminate(proc)
+                    rc = proc.returncode
+                    break
+            time.sleep(poll_interval)
+        if rc == 0 and not stalled:
+            return 0
+        if restarts >= max_restarts:
+            log("[watchdog] giving up after %d restarts (rc=%s)"
+                % (restarts, rc))
+            return rc if rc and rc > 0 else 1
+        restarts += 1
+        log("[watchdog] restart %d/%d (rc=%s%s)"
+            % (restarts, max_restarts, rc, ", stalled" if stalled else ""))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--max-restarts", type=int, default=2)
+    parser.add_argument("--num-workers", type=int, default=0,
+                        help="enable heartbeat-stall detection for N ranks")
+    parser.add_argument("--heartbeat-timeout", type=float, default=60.0)
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- command to supervise")
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given")
+    rc = supervise(command, max_restarts=args.max_restarts,
+                   num_workers=args.num_workers,
+                   heartbeat_timeout=args.heartbeat_timeout)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    main()

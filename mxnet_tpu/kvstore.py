@@ -49,6 +49,7 @@ class KVStore(object):
         self._store = {}
         self._updater = None
         self._barrier_count = 0
+        self._heartbeat = None
         # Multi-process distributed rank/size come from the JAX runtime
         # itself once a dist store is requested (the env names are only
         # the pre-init fallback): trusting env alone let round-2 report
@@ -106,6 +107,11 @@ class KVStore(object):
         Parity: KVStoreLocal::Push (kvstore_local.h) — merged = sum over
         the per-device list (Comm::Reduce), then updater(key, merged,
         stored) or plain store write."""
+        if self._heartbeat is not None:
+            # progress beat from the hot path: a rank wedged in a
+            # collective stops marking progress even though its liveness
+            # daemon keeps beating (parallel/heartbeat.py)
+            self._heartbeat.progress()
         for k, vals in _ctype_key_value(key, value):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
@@ -132,6 +138,8 @@ class KVStore(object):
     def pull(self, key, out=None, priority=0):
         """Broadcast stored value to out array(s) (Comm::Broadcast)."""
         assert out is not None
+        if self._heartbeat is not None:
+            self._heartbeat.progress()
         for k, outs in _ctype_key_value(key, out):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
@@ -203,6 +211,8 @@ class KVStore(object):
         Must hard-fail if a peer is unreachable — a barrier that
         swallows errors silently un-synchronizes exactly the path that
         exists to synchronize (round-1/2 finding, fixed)."""
+        if self._heartbeat is not None:
+            self._heartbeat.progress()
         if self._size > 1:
             from .parallel import barrier as _mesh_barrier
 

@@ -444,6 +444,11 @@ class Module(BaseModule):
         """Parity module.py:553."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        if (self._kvstore is not None
+                and getattr(self._kvstore, "_heartbeat", None) is not None):
+            # fused-path steps bypass kvstore push/pull, so mark training
+            # progress here too (parallel/heartbeat.py prog_<rank>)
+            self._kvstore._heartbeat.progress()
         if self._fused_trainer is not None:
             assert self._fused_batch is not None, "forward() before update()"
             owner = self._fused_owner

@@ -5,17 +5,26 @@ heartbeats to the scheduler and surfaces stale peers through
 ``KVStore::get_num_dead_node(node_id, timeout)`` (kvstore.h:235-244).
 The TPU build has no scheduler process — ICI/DCN collectives are the
 comm fabric — so liveness runs over the one medium every launcher
-already shares with its workers: the run directory. Each worker's
-``HeartbeatWriter`` daemon thread touches ``hb_<rank>`` every
-``interval`` seconds; any process (a peer's kvstore, the watchdog, an
-operator's shell) can then read staleness with ``dead_nodes``. This is
+already shares with its workers: the run directory. This is
 deliberately not a collective: liveness checks must keep working
 exactly when collectives hang.
 
+Two signals per rank, two files:
+
+* ``hb_<rank>`` — **process liveness.** Touched every ``interval``
+  seconds by a daemon thread. Detects dead/frozen processes, NOT a main
+  thread wedged in a collective (the daemon keeps beating).
+* ``prog_<rank>`` — **training progress.** Touched (rate-limited) from
+  the worker's own hot path — KVStore push/pull/barrier call
+  ``HeartbeatWriter.progress()``. A rank hung inside a collective stops
+  touching this one, so ``tools/watchdog.py --progress-timeout`` catches
+  exactly the hang class the liveness beat cannot. The timeout must
+  exceed the longest legitimate gap between optimizer steps (first XLA
+  compile included).
+
 ``tools/launch.py`` exports ``MXTPU_RUN_DIR`` so heartbeats start
 automatically whenever a dist kvstore is created; ``tools/watchdog.py``
-supervises a training command with the same signals (exit code +
-heartbeat staleness) and restarts it from its checkpoints.
+supervises a training command on exit code + both staleness signals.
 """
 import os
 import threading
@@ -23,6 +32,7 @@ import time
 
 RUN_DIR_ENV = "MXTPU_RUN_DIR"
 _HB_PREFIX = "hb_"
+_PROG_PREFIX = "prog_"
 
 
 def run_dir():
@@ -31,21 +41,34 @@ def run_dir():
     return os.environ.get(RUN_DIR_ENV) or None
 
 
+def _touch(path):
+    with open(path, "a"):
+        pass
+    os.utime(path, None)
+
+
 class HeartbeatWriter:
     """Touch ``<run_dir>/hb_<rank>`` every ``interval`` seconds from a
-    daemon thread (reference analog: Van::Heartbeat thread)."""
+    daemon thread (reference analog: Van::Heartbeat thread), and
+    ``prog_<rank>`` whenever the worker reports forward progress."""
 
     def __init__(self, directory, rank, interval=2.0):
+        self._dir = directory
         self._path = os.path.join(directory, "%s%d" % (_HB_PREFIX, rank))
+        self._prog_path = os.path.join(
+            directory, "%s%d" % (_PROG_PREFIX, rank))
         self._interval = float(interval)
         self._stop = threading.Event()
         self._thread = None
+        self._last_prog = 0.0
         os.makedirs(directory, exist_ok=True)
 
     def start(self):
         if self._thread is not None:
             return self
+        self._stop.clear()  # writers are restartable (stop() then start())
         self._beat()
+        self.progress()
         self._thread = threading.Thread(
             target=self._loop, name="mxtpu-heartbeat", daemon=True)
         self._thread.start()
@@ -57,23 +80,39 @@ class HeartbeatWriter:
             self._thread.join(timeout=self._interval + 1.0)
             self._thread = None
 
+    def progress(self):
+        """Mark forward progress from the worker's OWN thread (kvstore
+        push/pull/barrier). Rate-limited to one touch per interval so
+        per-key push loops don't turn into an utime storm."""
+        now = time.monotonic()
+        if now - self._last_prog < self._interval:
+            return
+        self._last_prog = now
+        try:
+            _touch(self._prog_path)
+        except OSError:
+            pass  # progress is advisory; liveness beat handles teardown
+
     def _beat(self):
         # liveness is the file's mtime (all dead_nodes reads); touch is
         # cheaper and atomic vs the readers, no payload needed
-        with open(self._path, "a"):
-            pass
-        os.utime(self._path, None)
+        _touch(self._path)
 
     def _loop(self):
         while not self._stop.wait(self._interval):
             try:
                 self._beat()
             except OSError:
-                # run dir vanished (job teardown) — stop quietly
-                return
+                # Only give up if the run dir is actually gone (job
+                # teardown); transient write errors (ENOSPC blip, NFS
+                # hiccup) must not silently stop liveness and get a
+                # healthy job killed.
+                if not os.path.isdir(self._dir):
+                    return
 
 
-def dead_nodes(directory, num_workers, timeout=60.0, now=None):
+def dead_nodes(directory, num_workers, timeout=60.0, now=None,
+               prefix=_HB_PREFIX):
     """Ranks whose heartbeat is missing or older than ``timeout`` seconds.
 
     Semantics of ``get_num_dead_node``: a node that never wrote a
@@ -82,7 +121,7 @@ def dead_nodes(directory, num_workers, timeout=60.0, now=None):
     now = time.time() if now is None else now
     dead = []
     for rank in range(int(num_workers)):
-        path = os.path.join(directory, "%s%d" % (_HB_PREFIX, rank))
+        path = os.path.join(directory, "%s%d" % (prefix, rank))
         try:
             age = now - os.path.getmtime(path)
         except OSError:
@@ -91,3 +130,13 @@ def dead_nodes(directory, num_workers, timeout=60.0, now=None):
         if age > timeout:
             dead.append(rank)
     return dead
+
+
+def stalled_nodes(directory, num_workers, timeout, now=None):
+    """Ranks alive (process beating) but without recent progress — the
+    wedged-in-a-collective signature."""
+    alive = set(range(int(num_workers))) - set(
+        dead_nodes(directory, num_workers, timeout, now=now))
+    no_progress = dead_nodes(directory, num_workers, timeout, now=now,
+                             prefix=_PROG_PREFIX)
+    return sorted(alive & set(no_progress))

@@ -151,6 +151,36 @@ def test_watchdog_startup_deadline(tmp_path):
     assert rc == 0
 
 
+def test_watchdog_catches_wedged_collective(tmp_path):
+    """The hang class liveness beats CANNOT catch: the process is alive
+    (daemon thread keeps beating) but the main thread is wedged — e.g.
+    inside a collective. Progress marks stop; --progress-timeout fires."""
+    script = tmp_path / "wedge_collective.py"
+    flag = str(tmp_path / "wedged_once")
+    script.write_text(textwrap.dedent("""
+        import importlib.util, os, sys, time
+        spec = importlib.util.spec_from_file_location(
+            "hb", os.path.join(%r, "mxnet_tpu", "parallel", "heartbeat.py"))
+        hb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(hb)
+        flag = sys.argv[1]
+        w = hb.HeartbeatWriter(os.environ["MXTPU_RUN_DIR"], 0,
+                               interval=0.2).start()
+        if os.path.exists(flag):
+            sys.exit(0)          # second attempt: healthy
+        open(flag, "w").close()
+        time.sleep(600)          # liveness keeps beating; progress stops
+    """ % REPO))
+    logs = []
+    rc = watchdog.supervise(
+        [sys.executable, str(script), flag],
+        max_restarts=1, num_workers=1, heartbeat_timeout=60.0,
+        progress_timeout=2.0, poll_interval=0.3,
+        run_dir=str(tmp_path / "run"), log=logs.append)
+    assert rc == 0
+    assert any("no training progress" in m for m in logs), logs
+
+
 def test_watchdog_kills_hung_job(tmp_path):
     """Hang detection: a worker that stops heartbeating gets killed and
     the job restarted — exit codes alone can never catch this."""

@@ -1,0 +1,255 @@
+"""Broad forward-correctness sweep: imperative ops vs numpy closed forms.
+
+Reference model: tests/python/unittest/test_operator.py +
+test_ndarray.py — every op's forward checked against a numpy ground
+truth. One table row per (op, config); runs through the jit-cached
+imperative dispatch (mx.nd.<op>), so this also pins the
+MXImperativeInvoke-analog path the FD gradient sweep doesn't touch.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_rng = np.random.RandomState(11)
+
+
+def _a(shape=(3, 4), lo=-2.0, hi=2.0):
+    return _rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+def _pos(shape=(3, 4)):
+    return _rng.uniform(0.4, 2.5, shape).astype(np.float32)
+
+
+def _case(name, op, np_ref, inputs, attrs=None, rtol=1e-5, atol=1e-5):
+    return pytest.param(op, np_ref, inputs, attrs or {}, rtol, atol, id=name)
+
+
+_X = _a()
+_Y = _a()
+_P = _pos()
+_ROW = _a((1, 4))
+_COL = _a((3, 1))
+_SPREAD = _rng.permutation(
+    np.linspace(-2, 2, 24).astype(np.float32)).reshape(2, 3, 4)
+
+CASES = [
+    # ---- unary math -------------------------------------------------------
+    _case("exp", "exp", np.exp, [_X]),
+    _case("expm1", "expm1", np.expm1, [_X]),
+    _case("log", "log", np.log, [_P]),
+    _case("log1p", "log1p", np.log1p, [_P]),
+    _case("log2", "log2", np.log2, [_P]),
+    _case("log10", "log10", np.log10, [_P]),
+    _case("sqrt", "sqrt", np.sqrt, [_P]),
+    _case("rsqrt", "rsqrt", lambda x: 1 / np.sqrt(x), [_P]),
+    _case("cbrt", "cbrt", np.cbrt, [_X]),
+    _case("rcbrt", "rcbrt", lambda x: 1 / np.cbrt(x), [_P]),
+    _case("square", "square", np.square, [_X]),
+    _case("reciprocal", "reciprocal", lambda x: 1 / x, [_P]),
+    _case("negative", "negative", np.negative, [_X]),
+    _case("sign", "sign", np.sign, [_X]),
+    _case("round", "round", np.round, [_X]),
+    _case("rint", "rint", np.rint, [_X]),
+    _case("ceil", "ceil", np.ceil, [_X]),
+    _case("floor", "floor", np.floor, [_X]),
+    _case("trunc", "trunc", np.trunc, [_X]),
+    _case("fix", "fix", np.fix, [_X]),
+    _case("sin", "sin", np.sin, [_X]),
+    _case("cos", "cos", np.cos, [_X]),
+    _case("tan", "tan", np.tan, [_a(lo=-1.0, hi=1.0)]),
+    _case("sinh", "sinh", np.sinh, [_X]),
+    _case("cosh", "cosh", np.cosh, [_X]),
+    _case("tanh", "tanh", np.tanh, [_X]),
+    _case("arctanh", "arctanh", np.arctanh, [_a(lo=-0.8, hi=0.8)]),
+    _case("degrees", "degrees", np.degrees, [_X]),
+    _case("radians", "radians", np.radians, [_X]),
+    _case("erf", "erf", None, [_X]),  # ref computed via scipy-free series? use math.erf
+    _case("sigmoid", "sigmoid", lambda x: 1 / (1 + np.exp(-x)), [_X]),
+    _case("relu", "relu", lambda x: np.maximum(x, 0), [_X]),
+    _case("softsign", "softsign", lambda x: x / (1 + np.abs(x)), [_X]),
+    _case("gamma", "gamma", None, [_P]),      # ref via math.gamma below
+    _case("gammaln", "gammaln", None, [_P]),  # ref via math.lgamma below
+    _case("identity", "identity", lambda x: x, [_X]),
+    _case("stop_gradient", "stop_gradient", lambda x: x, [_X]),
+    # ---- binary / broadcast ----------------------------------------------
+    _case("elemwise_add", "elemwise_add", np.add, [_X, _Y]),
+    _case("elemwise_sub", "elemwise_sub", np.subtract, [_X, _Y]),
+    _case("elemwise_mul", "elemwise_mul", np.multiply, [_X, _Y]),
+    _case("elemwise_div", "elemwise_div", np.divide, [_X, _P]),
+    _case("broadcast_add", "broadcast_add", np.add, [_COL, _ROW]),
+    _case("broadcast_sub", "broadcast_sub", np.subtract, [_COL, _ROW]),
+    _case("broadcast_mul", "broadcast_mul", np.multiply, [_COL, _ROW]),
+    _case("broadcast_div", "broadcast_div", np.divide, [_COL, _pos((1, 4))]),
+    _case("broadcast_mod", "broadcast_mod", np.mod, [_pos(), _pos((1, 4))]),
+    _case("broadcast_power", "broadcast_power", np.power, [_P, _a((1, 4))]),
+    _case("broadcast_maximum", "broadcast_maximum", np.maximum, [_COL, _ROW]),
+    _case("broadcast_minimum", "broadcast_minimum", np.minimum, [_COL, _ROW]),
+    _case("broadcast_hypot", "broadcast_hypot", np.hypot, [_COL, _ROW]),
+    _case("broadcast_equal", "broadcast_equal",
+          lambda a, b: (a == b).astype(np.float32), [_X, _X]),
+    _case("broadcast_not_equal", "broadcast_not_equal",
+          lambda a, b: (a != b).astype(np.float32), [_COL, _ROW]),
+    _case("broadcast_greater", "broadcast_greater",
+          lambda a, b: (a > b).astype(np.float32), [_COL, _ROW]),
+    _case("broadcast_greater_equal", "broadcast_greater_equal",
+          lambda a, b: (a >= b).astype(np.float32), [_COL, _ROW]),
+    _case("broadcast_lesser", "broadcast_lesser",
+          lambda a, b: (a < b).astype(np.float32), [_COL, _ROW]),
+    _case("broadcast_lesser_equal", "broadcast_lesser_equal",
+          lambda a, b: (a <= b).astype(np.float32), [_COL, _ROW]),
+    _case("broadcast_to", "broadcast_to",
+          lambda x: np.broadcast_to(x, (3, 4)), [_ROW],
+          attrs={"shape": (3, 4)}),
+    # ---- reductions -------------------------------------------------------
+    _case("sum", "sum", lambda x: np.sum(x), [_X]),
+    _case("sum_axis0", "sum", lambda x: np.sum(x, 0), [_X],
+          attrs={"axis": 0}),
+    _case("sum_keepdims", "sum", lambda x: np.sum(x, 1, keepdims=True),
+          [_X], attrs={"axis": 1, "keepdims": True}),
+    _case("mean", "mean", lambda x: np.mean(x, 1), [_X], attrs={"axis": 1}),
+    _case("prod", "prod", lambda x: np.prod(x, 1), [_P], attrs={"axis": 1}),
+    _case("max", "max", lambda x: np.max(x, 0), [_X], attrs={"axis": 0}),
+    _case("min", "min", lambda x: np.min(x, 0), [_X], attrs={"axis": 0}),
+    _case("norm", "norm", lambda x: np.array(
+        np.sqrt((x * x).sum()), np.float32), [_X]),
+    _case("nansum", "nansum",
+          lambda x: np.nansum(x, 1),
+          [np.where(_X > 1.0, np.nan, _X).astype(np.float32)],
+          attrs={"axis": 1}),
+    _case("nanprod", "nanprod",
+          lambda x: np.nanprod(x, 1),
+          [np.where(_P > 2.0, np.nan, _P).astype(np.float32)],
+          attrs={"axis": 1}),
+    # ---- shape / matrix ---------------------------------------------------
+    _case("dot", "dot", lambda a, b: a.dot(b), [_a((3, 4)), _a((4, 5))],
+          rtol=1e-4, atol=1e-4),
+    _case("batch_dot", "batch_dot", lambda a, b: np.einsum(
+        "bij,bjk->bik", a, b), [_a((2, 3, 4)), _a((2, 4, 5))],
+          rtol=1e-4, atol=1e-4),
+    _case("transpose", "transpose", lambda x: x.T, [_X]),
+    _case("transpose_axes", "transpose",
+          lambda x: x.transpose(0, 2, 1), [_a((2, 3, 4))],
+          attrs={"axes": (0, 2, 1)}),
+    _case("swapaxes", "swapaxes", lambda x: x.swapaxes(1, 2),
+          [_a((2, 3, 4))], attrs={"dim1": 1, "dim2": 2}),
+    _case("reshape", "reshape", lambda x: x.reshape(4, 3), [_X],
+          attrs={"shape": (4, 3)}),
+    _case("flatten", "flatten", lambda x: x.reshape(2, 12), [_a((2, 3, 4))]),
+    _case("expand_dims", "expand_dims", lambda x: x[:, None], [_X],
+          attrs={"axis": 1}),
+    _case("slice", "slice", lambda x: x[1:3, 0:2], [_X],
+          attrs={"begin": (1, 0), "end": (3, 2)}),
+    _case("slice_axis", "slice_axis", lambda x: x[:, 1:3], [_X],
+          attrs={"axis": 1, "begin": 1, "end": 3}),
+    _case("clip", "clip", lambda x: np.clip(x, -1, 1), [_X],
+          attrs={"a_min": -1.0, "a_max": 1.0}),
+    _case("repeat", "repeat", lambda x: np.repeat(x, 2, 1), [_X],
+          attrs={"repeats": 2, "axis": 1}),
+    _case("tile", "tile", lambda x: np.tile(x, (2, 3)), [_X],
+          attrs={"reps": (2, 3)}),
+    _case("reverse", "reverse", lambda x: x[:, ::-1], [_X],
+          attrs={"axis": 1}),
+    _case("flip", "flip", lambda x: x[::-1], [_X], attrs={"axis": 0}),
+    _case("pad", "pad", lambda x: np.pad(
+        x, ((0, 0), (0, 0), (1, 1), (2, 2)), constant_values=5.0),
+          [_a((2, 3, 4, 5))],
+          attrs={"mode": "constant", "constant_value": 5.0,
+                 "pad_width": (0, 0, 0, 0, 1, 1, 2, 2)}),
+    _case("cast", "cast", lambda x: x.astype(np.int32), [_X],
+          attrs={"dtype": "int32"}),
+    # ---- indexing ---------------------------------------------------------
+    _case("argmax", "argmax", lambda x: np.argmax(x, 1).astype(np.float32),
+          [_SPREAD[0]], attrs={"axis": 1}),
+    _case("argmin", "argmin", lambda x: np.argmin(x, 1).astype(np.float32),
+          [_SPREAD[0]], attrs={"axis": 1}),
+    _case("argmax_channel", "argmax_channel",
+          lambda x: np.argmax(x, 1).astype(np.float32), [_SPREAD[0]]),
+    _case("take", "take", lambda a, i: a[i.astype(np.int64)],
+          [_a((5, 3)), np.array([0, 4, 2, 2], np.float32)]),
+    _case("batch_take", "batch_take",
+          lambda a, i: a[np.arange(3), i.astype(np.int64)],
+          [_a((3, 4)), np.array([1, 3, 0], np.float32)]),
+    _case("pick", "pick",
+          lambda a, i: a[np.arange(3), i.astype(np.int64)],
+          [_a((3, 4)), np.array([1, 3, 0], np.float32)]),
+    _case("one_hot", "one_hot", lambda i: np.eye(5, dtype=np.float32)[
+        i.astype(np.int64)], [np.array([0, 3, 4, 1], np.float32)],
+          attrs={"depth": 5}),
+    _case("where", "where", lambda c, a, b: np.where(c != 0, a, b),
+          [(_X > 0).astype(np.float32), _Y, _a()]),
+    # ---- ordering ---------------------------------------------------------
+    _case("sort", "sort", lambda x: np.sort(x, 1), [_SPREAD[1]]),
+    _case("sort_desc", "sort", lambda x: -np.sort(-x, 1), [_SPREAD[1]],
+          attrs={"is_ascend": False}),
+    _case("argsort", "argsort",
+          lambda x: np.argsort(x, 1).astype(np.float32), [_SPREAD[1]]),
+    _case("topk", "topk",
+          lambda x: np.argsort(-x, 1)[:, :2].astype(np.float32),
+          [_SPREAD[1]], attrs={"k": 2}),
+    # ---- nn-adjacent closed forms ----------------------------------------
+    _case("softmax", "softmax",
+          lambda x: np.exp(x - x.max(1, keepdims=True)) /
+          np.exp(x - x.max(1, keepdims=True)).sum(1, keepdims=True),
+          [_X]),
+    _case("log_softmax", "log_softmax",
+          lambda x: x - x.max(1, keepdims=True) - np.log(
+              np.exp(x - x.max(1, keepdims=True)).sum(1, keepdims=True)),
+          [_X]),
+    _case("smooth_l1", "smooth_l1",
+          lambda x: np.where(np.abs(x) < 1, 0.5 * x * x,
+                             np.abs(x) - 0.5).astype(np.float32),
+          [_X], attrs={"scalar": 1.0}),
+    _case("softmax_cross_entropy", "softmax_cross_entropy",
+          lambda x, l: np.array([-np.sum(np.log(
+              np.exp(x - x.max(1, keepdims=True)) /
+              np.exp(x - x.max(1, keepdims=True)).sum(1, keepdims=True)
+          )[np.arange(3), l.astype(np.int64)])], np.float32),
+          [_X, np.array([1, 0, 3], np.float32)], rtol=1e-4, atol=1e-4),
+]
+
+
+@pytest.mark.parametrize("op,np_ref,inputs,attrs,rtol,atol", CASES)
+def test_forward_matches_numpy(op, np_ref, inputs, attrs, rtol, atol):
+    import math
+
+    if np_ref is None:
+        np_ref = {
+            "erf": lambda x: np.vectorize(math.erf)(x).astype(np.float32),
+            "gamma": lambda x: np.vectorize(math.gamma)(x).astype(np.float32),
+            "gammaln": lambda x: np.vectorize(
+                math.lgamma)(x).astype(np.float32),
+        }[op]
+    fn = getattr(mx.nd, op)
+    got = fn(*[mx.nd.array(x) for x in inputs], **attrs)
+    if isinstance(got, (list, tuple)):
+        got = got[0]
+    want = np_ref(*inputs)
+    assert got.shape == tuple(np.asarray(want).shape)
+    np.testing.assert_allclose(got.asnumpy().astype(np.float64),
+                               np.asarray(want).astype(np.float64),
+                               rtol=rtol, atol=atol)
+
+
+def test_scalar_op_family():
+    """_plus_scalar/_rminus_scalar/... — the operator-overload backing ops
+    (reference elemwise_binary_scalar_op.cc family)."""
+    x = mx.nd.array(_X)
+    np.testing.assert_allclose((x + 1.5).asnumpy(), _X + 1.5, rtol=1e-6)
+    np.testing.assert_allclose((1.5 - x).asnumpy(), 1.5 - _X, rtol=1e-6)
+    np.testing.assert_allclose((x * 3.0).asnumpy(), _X * 3.0, rtol=1e-6)
+    np.testing.assert_allclose((2.0 / (x + 4.0)).asnumpy(),
+                               2.0 / (_X + 4.0), rtol=1e-6)
+    np.testing.assert_allclose((x ** 2.0).asnumpy(), _X ** 2.0, rtol=1e-5)
+    np.testing.assert_allclose((x > 0).asnumpy(), (_X > 0).astype(np.float32))
+
+
+def test_split_and_concat_roundtrip():
+    x = _a((4, 6))
+    parts = mx.nd.split(mx.nd.array(x), num_outputs=3, axis=1)
+    assert len(parts) == 3
+    for i, p in enumerate(parts):
+        np.testing.assert_allclose(p.asnumpy(), x[:, 2 * i:2 * i + 2])
+    back = mx.nd.concat(*parts, dim=1)
+    np.testing.assert_allclose(back.asnumpy(), x)

@@ -31,6 +31,7 @@ import argparse
 import os
 import signal
 import subprocess
+import shutil
 import sys
 import tempfile
 import time
@@ -60,9 +61,11 @@ def launch_local(num_workers, command, coordinator_port=29500):
     coordinator = "127.0.0.1:%d" % coordinator_port
     # honor a supervisor-provided liveness dir (tools/watchdog.py sets
     # MXTPU_RUN_DIR and polls it for stalls) — only mint our own when
-    # running standalone
-    run_dir = os.environ.get("MXTPU_RUN_DIR") or tempfile.mkdtemp(
-        prefix="mxtpu_run_")
+    # running standalone (and clean the minted one up on exit)
+    run_dir = os.environ.get("MXTPU_RUN_DIR")
+    own_run_dir = None
+    if not run_dir:
+        run_dir = own_run_dir = tempfile.mkdtemp(prefix="mxtpu_run_")
     procs = []
     for rank in range(num_workers):
         procs.append(subprocess.Popen(
@@ -88,6 +91,8 @@ def launch_local(num_workers, command, coordinator_port=29500):
     rc = 0
     for p in procs:
         rc |= p.wait()
+    if own_run_dir:
+        shutil.rmtree(own_run_dir, ignore_errors=True)
     return rc
 
 
@@ -97,10 +102,15 @@ def launch_ssh(hosts, num_workers, command, coordinator_port=29500,
     procs = []
     for rank in range(num_workers):
         host = hosts[rank % len(hosts)]
-        env = worker_env(rank, num_workers, coordinator)
+        # a supervisor-provided MXTPU_RUN_DIR is forwarded so remote
+        # workers heartbeat somewhere the supervisor can see (requires a
+        # shared filesystem, like the reference's dmlc tracker logs);
+        # without one, liveness stays local-only
+        env = worker_env(rank, num_workers, coordinator,
+                         os.environ.get("MXTPU_RUN_DIR"))
         exports = " ".join(
             "%s=%s" % (k, v) for k, v in env.items()
-            if k.startswith(("JAX_", "DMLC_")))
+            if k.startswith(("JAX_", "DMLC_", "MXTPU_")))
         remote = "cd %s && env %s %s" % (
             os.getcwd(), exports, " ".join(command))
         cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]

@@ -28,6 +28,7 @@ import glob
 import os
 import re
 import signal
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -45,40 +46,63 @@ def find_latest_checkpoint(prefix):
     return best
 
 
-def _terminate(proc):
-    proc.terminate()
+def _terminate(proc, grace=15):
+    """Terminate the supervised job AND its whole process group: the
+    command is typically a launcher whose workers must not survive the
+    kill (an orphan would keep heartbeating into the reused run dir and
+    hold the coordinator port against the restart)."""
+    def _signal_group(sig):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            if proc.poll() is None:
+                proc.send_signal(sig)
+
+    _signal_group(signal.SIGTERM)
     try:
-        proc.wait(timeout=10)
+        proc.wait(timeout=grace)
     except subprocess.TimeoutExpired:
-        proc.kill()
+        _signal_group(signal.SIGKILL)
         proc.wait()
 
 
 def supervise(command, max_restarts=2, num_workers=0,
               heartbeat_timeout=60.0, poll_interval=1.0, run_dir=None,
-              startup_timeout=300.0, log=print):
+              startup_timeout=300.0, progress_timeout=None, log=print):
     """Run ``command`` under supervision; returns the final exit code
     (0 success, positive failure — signal deaths are normalized to 1 so
     callers see a stable code).
 
-    ``num_workers > 0`` enables heartbeat-stall detection. Slow startup
-    is not a false positive — staleness only counts once every expected
-    rank has beaten at least once — but a rank that never beats at all
-    (e.g. wedged in distributed init) trips the ``startup_timeout``
-    deadline instead, so pre-first-heartbeat hangs are still caught."""
+    ``num_workers > 0`` enables liveness monitoring with three stall
+    classes (mxnet_tpu/parallel/heartbeat.py):
+      * process death/freeze — ``hb_<rank>`` stale past
+        ``heartbeat_timeout`` (only once every rank beat at least once,
+        so slow startup is not a false positive);
+      * pre-first-heartbeat wedge (e.g. stuck distributed init) —
+        ``startup_timeout`` deadline;
+      * wedged-in-a-collective — process alive but no training progress
+        (``prog_<rank>``) for ``progress_timeout`` seconds. Off by
+        default: set it ABOVE the longest legitimate step gap,
+        first-compile included.
+    """
     from mxnet_tpu.parallel import heartbeat as hb
 
     restarts = 0
+    own_run_dir = None
     while True:
         env = dict(os.environ)
         if num_workers > 0:
-            run_dir = run_dir or tempfile.mkdtemp(prefix="mxtpu_watchdog_")
+            if run_dir is None:
+                run_dir = own_run_dir = tempfile.mkdtemp(
+                    prefix="mxtpu_watchdog_")
             os.makedirs(run_dir, exist_ok=True)
             # fresh staleness baseline per attempt
-            for p in glob.glob(os.path.join(run_dir, "hb_*")):
+            for p in glob.glob(os.path.join(run_dir, "hb_*")) + \
+                    glob.glob(os.path.join(run_dir, "prog_*")):
                 os.unlink(p)
             env[hb.RUN_DIR_ENV] = run_dir
-        proc = subprocess.Popen(command, env=env)
+        # own process group so a stall-kill reaps the launcher's workers
+        proc = subprocess.Popen(command, env=env, start_new_session=True)
         started_at = time.time()
         stalled = False
         while True:
@@ -88,25 +112,34 @@ def supervise(command, max_restarts=2, num_workers=0,
             if num_workers > 0:
                 all_started = not hb.dead_nodes(
                     run_dir, num_workers, timeout=float("inf"))
-                if all_started:
-                    stalled = bool(hb.dead_nodes(
-                        run_dir, num_workers, heartbeat_timeout))
-                    reason = "heartbeat stall (> %.0fs)" % heartbeat_timeout
-                else:
-                    stalled = time.time() - started_at > startup_timeout
-                    reason = ("no heartbeat from every rank within "
-                              "%.0fs of start" % startup_timeout)
-                if stalled:
+                reason = None
+                if not all_started:
+                    if time.time() - started_at > startup_timeout:
+                        reason = ("no heartbeat from every rank within "
+                                  "%.0fs of start" % startup_timeout)
+                elif hb.dead_nodes(run_dir, num_workers, heartbeat_timeout):
+                    reason = ("heartbeat stall (> %.0fs)"
+                              % heartbeat_timeout)
+                elif progress_timeout and hb.stalled_nodes(
+                        run_dir, num_workers, progress_timeout):
+                    reason = ("alive but no training progress (> %.0fs) "
+                              "— wedged collective?" % progress_timeout)
+                if reason is not None:
                     log("[watchdog] %s: killing job" % reason)
                     _terminate(proc)
+                    stalled = True
                     rc = proc.returncode
                     break
             time.sleep(poll_interval)
         if rc == 0 and not stalled:
+            if own_run_dir:
+                shutil.rmtree(own_run_dir, ignore_errors=True)
             return 0
         if restarts >= max_restarts:
             log("[watchdog] giving up after %d restarts (rc=%s)"
                 % (restarts, rc))
+            # minted run dir intentionally left behind: it is the
+            # post-mortem evidence (which ranks stopped beating when)
             return rc if rc and rc > 0 else 1
         restarts += 1
         log("[watchdog] restart %d/%d (rc=%s%s)"
@@ -120,6 +153,11 @@ def main(argv=None):
     parser.add_argument("--num-workers", type=int, default=0,
                         help="enable heartbeat-stall detection for N ranks")
     parser.add_argument("--heartbeat-timeout", type=float, default=60.0)
+    parser.add_argument("--progress-timeout", type=float, default=None,
+                        help="kill if a live rank makes no training "
+                             "progress for this long (catches wedged "
+                             "collectives; set above the longest "
+                             "legitimate step gap incl. first compile)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- command to supervise")
     args = parser.parse_args(argv)
@@ -130,7 +168,8 @@ def main(argv=None):
         parser.error("no command given")
     rc = supervise(command, max_restarts=args.max_restarts,
                    num_workers=args.num_workers,
-                   heartbeat_timeout=args.heartbeat_timeout)
+                   heartbeat_timeout=args.heartbeat_timeout,
+                   progress_timeout=args.progress_timeout)
     sys.exit(rc)
 
 

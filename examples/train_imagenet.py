@@ -41,6 +41,9 @@ def get_symbol(args):
     if name == "googlenet":
         from mxnet_tpu.models.googlenet import get_symbol as f
         return f(num_classes=args.num_classes)
+    if name == "inception-resnet-v2":
+        from mxnet_tpu.models.inception_resnet_v2 import get_symbol as f
+        return f(num_classes=args.num_classes)
     if name == "vgg":
         from mxnet_tpu.models.vgg import get_symbol as f
         return f(num_classes=args.num_classes,

@@ -12,6 +12,7 @@ from .alexnet import get_symbol as alexnet
 from .resnet import get_symbol as resnet
 from .inception_v3 import get_symbol as inception_v3
 from .inception_bn import get_symbol as inception_bn
+from .inception_resnet_v2 import get_symbol as inception_resnet_v2
 from .googlenet import get_symbol as googlenet
 from .resnext import get_symbol as resnext
 from .vgg import get_symbol as vgg

@@ -134,9 +134,22 @@ def dead_nodes(directory, num_workers, timeout=60.0, now=None,
 
 def stalled_nodes(directory, num_workers, timeout, now=None):
     """Ranks alive (process beating) but without recent progress — the
-    wedged-in-a-collective signature."""
+    wedged-in-a-collective signature.
+
+    A missing ``prog_`` file is "not yet started", not "stalled": the
+    initial progress touch can land after the liveness beat (start()
+    ordering) or be swallowed by a transient write error, and killing a
+    healthy job over that race would be worse than missing one poll.
+    Such a rank only counts once its prog file exists and is stale."""
+    now = time.time() if now is None else now
     alive = set(range(int(num_workers))) - set(
         dead_nodes(directory, num_workers, timeout, now=now))
-    no_progress = dead_nodes(directory, num_workers, timeout, now=now,
-                             prefix=_PROG_PREFIX)
-    return sorted(alive & set(no_progress))
+    stalled = []
+    for rank in sorted(alive):
+        path = os.path.join(directory, "%s%d" % (_PROG_PREFIX, rank))
+        try:
+            if now - os.path.getmtime(path) > timeout:
+                stalled.append(rank)
+        except OSError:
+            continue  # never progressed yet -> startup, not a stall
+    return stalled

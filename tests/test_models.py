@@ -21,6 +21,7 @@ from mxnet_tpu import models
         (models.resnext, {"num_layers": 101, "num_group": 64,
                           "bottleneck_width": 1.0}, 200),
         (models.inception_v3, {}, 90),
+        (models.inception_resnet_v2, {}, 400),
         (models.inception_bn, {}, 60),
         (models.googlenet, {}, 50),
     ],
@@ -28,8 +29,8 @@ from mxnet_tpu import models
 def test_zoo_shapes(builder, kwargs, n_args_min):
     num_classes = 1000
     sym = builder(num_classes=num_classes, **kwargs)
-    shape = (2, 3, 299, 299) if builder is models.inception_v3 \
-        else (2, 3, 224, 224)
+    shape = (2, 3, 299, 299) if builder in (
+        models.inception_v3, models.inception_resnet_v2) else (2, 3, 224, 224)
     args, outs, _ = sym.infer_shape(data=shape, softmax_label=(2,))
     assert outs == [(2, num_classes)]
     assert len(sym.list_arguments()) >= n_args_min

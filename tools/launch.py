@@ -72,6 +72,10 @@ def launch_local(num_workers, command, coordinator_port=29500):
             command,
             env=worker_env(rank, num_workers, coordinator, run_dir)))
 
+    def _cleanup_run_dir():
+        if own_run_dir:
+            shutil.rmtree(own_run_dir, ignore_errors=True)
+
     def _kill(*_):
         for p in procs:
             p.terminate()
@@ -84,15 +88,17 @@ def launch_local(num_workers, command, coordinator_port=29500):
                 p.wait()
         # fully reaped: a supervisor can relaunch immediately without
         # racing the old coordinator port
+        _cleanup_run_dir()
         sys.exit(1)
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
     rc = 0
-    for p in procs:
-        rc |= p.wait()
-    if own_run_dir:
-        shutil.rmtree(own_run_dir, ignore_errors=True)
+    try:
+        for p in procs:
+            rc |= p.wait()
+    finally:
+        _cleanup_run_dir()
     return rc
 
 

@@ -15,9 +15,13 @@ from .mesh import (
 )
 from .train_step import ShardedTrainStep
 from .ring_attention import ring_attention
+from .moe import switch_moe, init_moe_params, moe_partition_specs
+from .pipeline import pipeline_stages, pipelined_loss
 
 __all__ = [
     "make_mesh", "barrier", "dp_sharding", "replicated_sharding",
     "device_count", "ShardedTrainStep", "ring_attention",
     "init_distributed", "allreduce_sum", "broadcast_from_root",
+    "switch_moe", "init_moe_params", "moe_partition_specs",
+    "pipeline_stages", "pipelined_loss",
 ]

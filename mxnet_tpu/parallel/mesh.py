@@ -22,9 +22,9 @@ def device_count():
     return jax.device_count()
 
 
-def make_mesh(dp=None, tp=1, pp=1, sp=1, devices=None):
-    """Create a Mesh with axes (dp, tp, pp, sp). dp defaults to whatever is
-    left after tp*pp*sp."""
+def make_mesh(dp=None, tp=1, pp=1, sp=1, ep=1, devices=None):
+    """Create a Mesh with axes (dp, tp, pp, sp, ep). dp defaults to
+    whatever is left after tp*pp*sp*ep."""
     import jax
     from jax.sharding import Mesh
 
@@ -32,16 +32,17 @@ def make_mesh(dp=None, tp=1, pp=1, sp=1, devices=None):
         devices = jax.devices()
     n = len(devices)
     if dp is None:
-        assert n % (tp * pp * sp) == 0, (
-            "devices (%d) not divisible by tp*pp*sp (%d)" % (n, tp * pp * sp)
+        assert n % (tp * pp * sp * ep) == 0, (
+            "devices (%d) not divisible by tp*pp*sp*ep (%d)"
+            % (n, tp * pp * sp * ep)
         )
-        dp = n // (tp * pp * sp)
-    need = dp * tp * pp * sp
-    assert need <= n, "mesh %dx%dx%dx%d needs %d devices, have %d" % (
-        dp, tp, pp, sp, need, n
+        dp = n // (tp * pp * sp * ep)
+    need = dp * tp * pp * sp * ep
+    assert need <= n, "mesh %dx%dx%dx%dx%d needs %d devices, have %d" % (
+        dp, tp, pp, sp, ep, need, n
     )
-    dev_array = np.asarray(devices[:need]).reshape(dp, tp, pp, sp)
-    return Mesh(dev_array, ("dp", "tp", "pp", "sp"))
+    dev_array = np.asarray(devices[:need]).reshape(dp, tp, pp, sp, ep)
+    return Mesh(dev_array, ("dp", "tp", "pp", "sp", "ep"))
 
 
 def dp_sharding(mesh):

@@ -1,0 +1,95 @@
+"""Mixture-of-Experts FFN with expert parallelism (ep mesh axis).
+
+Beyond-reference capability (SURVEY.md §2.3 lists expert parallel as
+absent in the reference): the TPU-native MoE recipe in the
+Mesh-TensorFlow / GShard / Switch-Transformer lineage, written the XLA
+way — routing, dispatch and combine are einsums over dense one-hot
+dispatch tensors, and expert parallelism is nothing but a sharding
+annotation: expert-major tensors carry ``PartitionSpec("ep", ...)``,
+tokens stay dp-sharded, and GSPMD inserts the all-to-alls between the
+token and expert layouts. No hand-written collectives, so the same
+function runs single-device (tests) and on a dp x ep mesh (dryrun)
+with identical numerics.
+
+Routing is Switch-style top-1 with a capacity limit: tokens that
+overflow an expert's capacity are dropped (contribute zero), matching
+the published behavior; an auxiliary load-balance loss (Switch
+Transformer eq. 4) keeps the router from collapsing onto one expert.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(rng, d_model, d_hidden, num_experts, dtype=jnp.float32):
+    """Router + expert weights. Expert-major tensors lead with the E axis
+    so ``PartitionSpec("ep", ...)`` shards whole experts."""
+    import numpy as np
+
+    r = np.random.RandomState(rng)
+    scale = 1.0 / np.sqrt(d_model)
+    return {
+        "gate_w": jnp.asarray(
+            r.randn(d_model, num_experts) * scale, dtype),
+        "w_up": jnp.asarray(
+            r.randn(num_experts, d_model, d_hidden) * scale, dtype),
+        "w_down": jnp.asarray(
+            r.randn(num_experts, d_hidden, d_model) / np.sqrt(d_hidden),
+            dtype),
+    }
+
+
+def moe_partition_specs():
+    """PartitionSpecs for init_moe_params output on a (dp, ..., ep) mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "gate_w": P(),                 # router replicated
+        "w_up": P("ep", None, None),   # whole experts per ep shard
+        "w_down": P("ep", None, None),
+    }
+
+
+def switch_moe(params, x, capacity_factor=1.25):
+    """Top-1 MoE FFN. x: [tokens, d_model] -> ([tokens, d_model], aux_loss).
+
+    Dense-dispatch formulation: dispatch/combine are [tokens, E, C]
+    one-hots, expert compute is a batched einsum over [E, C, d] — the
+    shape GSPMD splits cleanly along E (ep axis) with all-to-alls at the
+    einsum boundaries.
+    """
+    tokens, d_model = x.shape
+    num_experts = params["gate_w"].shape[1]
+    capacity = int(max(1, tokens * capacity_factor / num_experts))
+
+    logits = x.astype(jnp.float32) @ params["gate_w"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)              # [T, E]
+    expert_idx = jnp.argmax(probs, axis=-1)              # [T]
+    expert_prob = jnp.take_along_axis(
+        probs, expert_idx[:, None], axis=-1)[:, 0]       # [T]
+    assign = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+
+    # position of each token within its expert's queue; >= capacity drops
+    pos_in_expert = (jnp.cumsum(assign, axis=0) - assign) * assign  # [T, E]
+    keep = (pos_in_expert < capacity) * assign                      # [T, E]
+    pos = pos_in_expert.sum(-1).astype(jnp.int32)                   # [T]
+    pos_hot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)      # [T, C]
+
+    dispatch = keep[:, :, None] * pos_hot[:, None, :]    # [T, E, C]
+    combine = dispatch * expert_prob[:, None, None]      # [T, E, C]
+
+    # token layout -> expert layout (GSPMD: all-to-all over ep here)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    h = jax.nn.relu(jnp.einsum(
+        "ecd,edh->ech", expert_in, params["w_up"].astype(jnp.float32)))
+    expert_out = jnp.einsum(
+        "ech,ehd->ecd", h, params["w_down"].astype(jnp.float32))
+    # expert layout -> token layout (all-to-all back)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # Switch load-balance loss: E * sum_e fraction_tokens_e * mean_prob_e
+    frac_tokens = assign.mean(0)
+    mean_prob = probs.mean(0)
+    aux_loss = num_experts * jnp.sum(frac_tokens * mean_prob)
+    return y.astype(x.dtype), aux_loss
+
+

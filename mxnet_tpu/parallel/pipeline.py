@@ -1,0 +1,92 @@
+"""Pipeline parallelism over the pp mesh axis (GPipe schedule).
+
+Beyond-reference capability (SURVEY.md §2.3: the reference's closest
+analog is the model-parallel LSTM whose engine pipelines timesteps
+across devices implicitly). Here the schedule is explicit and
+TPU-native: inside ``shard_map`` over the ``pp`` axis each device holds
+ONE stage's parameters, and a ``lax.scan`` over M + S - 1 ticks moves
+activations stage-to-stage with ``ppermute`` — the collective-permute
+pipelining recipe (scaling-book "training" chapter; PAPERS.md GPipe).
+``ppermute`` is differentiable (its vjp is the reverse permute), so
+``jax.grad`` through this function yields the correct 1F1B-equivalent
+backward with no hand-written schedule.
+
+Layout contract:
+  * ``stage_params``: pytree whose leaves lead with an S axis, sharded
+    ``PartitionSpec("pp", ...)`` — inside shard_map each device sees its
+    own stage's slice (leading axis length 1, squeezed).
+  * ``x``: [M, mb, ...] microbatches, replicated across pp (only stage 0
+    reads it).
+  * returns [M, mb, ...] last-stage outputs, valid on the LAST pp rank
+    (other ranks return zeros — psum_gather or index at the caller).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_stages(stage_fn, stage_params, x, axis="pp"):
+    """GPipe forward inside shard_map over ``axis``.
+
+    stage_fn(params_slice, act) -> act, applied S times in sequence
+    across the pp ranks; M microbatches stream through with a bubble of
+    S - 1 ticks (GPipe fill/drain).
+    """
+    n_stages = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    n_micro = x.shape[0]
+    params_here = jax.tree_util.tree_map(
+        lambda p: jnp.squeeze(p, 0), stage_params)
+
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+    zero_act = jnp.zeros_like(stage_fn(params_here, x[0]))
+
+    def tick(carry, t):
+        recv = carry
+        # stage 0 feeds microbatch t (clamped; ticks past M are drain)
+        feed = x[jnp.minimum(t, n_micro - 1)]
+        act_in = jnp.where(stage == 0, feed, recv)
+        act_out = stage_fn(params_here, act_in)
+        # collect on the last stage for valid ticks t in [S-1, S-1+M)
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        collected = jnp.where(valid, act_out, zero_act)
+        sent = jax.lax.ppermute(act_out, axis, perm)
+        return sent, (collected, out_idx)
+
+    total_ticks = n_micro + n_stages - 1
+    _, (outs, idxs) = jax.lax.scan(
+        tick, zero_act, jnp.arange(total_ticks))
+    # scatter collected ticks into microbatch order; invalid ticks
+    # (fill bubble, idx < 0) are masked to zero and clamped onto slot 0,
+    # so on the final stage every microbatch lands exactly once
+    mask = (idxs >= 0).reshape((-1,) + (1,) * (outs.ndim - 1))
+    ys = jnp.zeros((n_micro,) + outs.shape[1:], outs.dtype)
+    ys = ys.at[jnp.clip(idxs, 0, n_micro - 1)].add(
+        jnp.where(mask, outs, 0.0))
+    return ys
+
+
+def pipelined_loss(stage_fn, loss_fn, mesh, axis="pp"):
+    """Build loss(params, x, y) running stages over the pp axis.
+
+    ``loss_fn(last_act, y) -> scalar`` is computed on the last stage and
+    psum-broadcast so every rank returns the same scalar (required for
+    jax.grad under shard_map).
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    def _inner(params, x, y):
+        outs = pipeline_stages(stage_fn, params, x, axis=axis)
+        n_stages = jax.lax.axis_size(axis)
+        is_last = jax.lax.axis_index(axis) == n_stages - 1
+        # zeros on non-final ranks; psum yields the last stage's loss
+        loss = jnp.where(is_last, loss_fn(outs, y), 0.0)
+        return jax.lax.psum(loss, axis)
+
+    # P(axis) is a pytree-prefix spec: every params leaf leads with the
+    # stacked stage axis and shards over pp; data/labels replicated.
+    return shard_map(
+        _inner, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P(),
+        check_vma=False,
+    )

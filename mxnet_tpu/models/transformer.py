@@ -16,15 +16,24 @@ import numpy as np
 
 
 def transformer_lm(vocab=32000, d_model=512, n_heads=8, n_layers=4,
-                   d_ff=2048, dtype=None):
+                   d_ff=2048, dtype=None, moe_experts=0, moe_every=2):
     """Returns (init_fn(rng, seq_len, batch) -> params,
-                apply_fn(params, tokens, mesh=None) -> logits)."""
+                apply_fn(params, tokens, mesh=None) -> logits).
+
+    ``moe_experts > 0`` replaces every ``moe_every``-th layer's FFN with
+    a Switch-MoE block (parallel/moe.py): expert weights lead with the E
+    axis so a dp x ep mesh shards them with ``moe_partition_specs`` and
+    GSPMD inserts the dispatch all-to-alls. MoE apply returns
+    ``(logits, aux_loss)`` — the load-balance term to add to the LM loss."""
     import jax
     import jax.numpy as jnp
 
     if dtype is None:
         dtype = jnp.bfloat16
     head_dim = d_model // n_heads
+
+    def _is_moe_layer(i):
+        return moe_experts > 0 and i % moe_every == moe_every - 1
 
     def init_fn(seed=0):
         rng = np.random.RandomState(seed)
@@ -35,16 +44,27 @@ def transformer_lm(vocab=32000, d_model=512, n_heads=8, n_layers=4,
 
         params = {"embed": w(vocab, d_model, scale=0.02)}
         for i in range(n_layers):
-            params["l%d" % i] = {
+            layer = {
                 "ln1": np.ones((d_model,), np.float32),
                 "ln2": np.ones((d_model,), np.float32),
                 "wq": w(d_model, n_heads * head_dim),
                 "wk": w(d_model, n_heads * head_dim),
                 "wv": w(d_model, n_heads * head_dim),
                 "wo": w(n_heads * head_dim, d_model),
-                "w1": w(d_model, d_ff),
-                "w2": w(d_ff, d_model),
             }
+            if _is_moe_layer(i):
+                # one source of truth for the MoE param layout
+                from ..parallel.moe import init_moe_params
+
+                layer["moe"] = {
+                    k: np.asarray(v) for k, v in init_moe_params(
+                        rng.randint(1 << 30), d_model, d_ff,
+                        moe_experts).items()
+                }
+            else:
+                layer["w1"] = w(d_model, d_ff)
+                layer["w2"] = w(d_ff, d_model)
+            params["l%d" % i] = layer
         params["ln_f"] = np.ones((d_model,), np.float32)
         return params
 
@@ -93,14 +113,26 @@ def transformer_lm(vocab=32000, d_model=512, n_heads=8, n_layers=4,
             np.concatenate([np.sin(pos), np.cos(pos)], axis=-1)[:T], dtype
         )
         x = x + pe[None]
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(n_layers):
             p = params["l%d" % i]
             x = x + attention(rmsnorm(x, p["ln1"].astype(dtype)), p, mesh)
             h = rmsnorm(x, p["ln2"].astype(dtype))
-            h = jax.nn.gelu(h @ p["w1"].astype(dtype))
-            x = x + h @ p["w2"].astype(dtype)
+            if _is_moe_layer(i):
+                from ..parallel.moe import switch_moe
+
+                B = h.shape[0]
+                y, aux = switch_moe(
+                    p["moe"], h.reshape(B * T, d_model))
+                x = x + y.reshape(B, T, d_model)
+                aux_total = aux_total + aux
+            else:
+                h = jax.nn.gelu(h @ p["w1"].astype(dtype))
+                x = x + h @ p["w2"].astype(dtype)
         x = rmsnorm(x, params["ln_f"].astype(dtype))
         logits = x.astype(jnp.float32) @ params["embed"].T
+        if moe_experts > 0:
+            return logits, aux_total
         return logits
 
     return init_fn, apply_fn

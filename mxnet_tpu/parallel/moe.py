@@ -77,14 +77,17 @@ def switch_moe(params, x, capacity_factor=1.25):
     dispatch = keep[:, :, None] * pos_hot[:, None, :]    # [T, E, C]
     combine = dispatch * expert_prob[:, None, None]      # [T, E, C]
 
+    # Routing above stays f32; the expert FFN itself runs in the caller's
+    # compute dtype (bf16 on the MXU) like the dense FFN it replaces.
+    cdtype = x.dtype
     # token layout -> expert layout (GSPMD: all-to-all over ep here)
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cdtype), x)
     h = jax.nn.relu(jnp.einsum(
-        "ecd,edh->ech", expert_in, params["w_up"].astype(jnp.float32)))
+        "ecd,edh->ech", expert_in, params["w_up"].astype(cdtype)))
     expert_out = jnp.einsum(
-        "ech,ehd->ecd", h, params["w_down"].astype(jnp.float32))
+        "ech,ehd->ecd", h, params["w_down"].astype(cdtype))
     # expert layout -> token layout (all-to-all back)
-    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    y = jnp.einsum("tec,ecd->td", combine.astype(cdtype), expert_out)
 
     # Switch load-balance loss: E * sum_e fraction_tokens_e * mean_prob_e
     frac_tokens = assign.mean(0)

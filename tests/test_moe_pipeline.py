@@ -101,6 +101,38 @@ def test_moe_gradients_flow_when_sharded():
             rtol=5e-5, atol=5e-5, err_msg=k)
 
 
+def test_moe_transformer_trains():
+    """The flagship LM with MoE FFN layers: loss (incl. load-balance aux)
+    falls under SGD, and expert weights receive gradients."""
+    from mxnet_tpu.models.transformer import transformer_lm
+
+    init_fn, apply_fn = transformer_lm(
+        vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, moe_experts=4, moe_every=2)
+    params = jax.tree_util.tree_map(jnp.asarray, init_fn(0))
+    r = np.random.RandomState(0)
+    tokens = jnp.asarray(r.randint(0, 64, (4, 16)))
+
+    def loss(p):
+        logits, aux = apply_fn(p, tokens)
+        tgt = tokens[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1])
+        nll = -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], -1))
+        return nll + 0.01 * aux
+
+    vg = jax.jit(jax.value_and_grad(loss))
+    l0 = None
+    for _ in range(10):
+        l, g = vg(params)
+        l0 = l0 if l0 is not None else float(l)
+        params = jax.tree_util.tree_map(lambda p, gr: p - 0.5 * gr, params, g)
+    l1 = float(loss(params))
+    assert l1 < l0, (l0, l1)
+    gm = g["l1"]["moe"]
+    assert float(jnp.abs(gm["w_up"]).sum()) > 0
+    assert float(jnp.abs(gm["gate_w"]).sum()) > 0
+
+
 # ---------------------------------------------------------------------------
 # Pipeline
 # ---------------------------------------------------------------------------

@@ -18,6 +18,7 @@ offline-complete (swap in a token file per the README for real text).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -46,9 +47,9 @@ def main():
                    choices=["float32", "bfloat16"])
     args = p.parse_args()
 
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
     if args.cpu:
-        sys.path.insert(0, ".")
-        sys.path.insert(0, "..")
         from __graft_entry__ import _force_cpu_mesh_platform
 
         _force_cpu_mesh_platform(8)
@@ -89,6 +90,13 @@ def main():
     def spec_for(path_key, leaf):
         if "moe" in path_key:
             return moe_specs[path_key.split("/")[-1]]
+        leafname = path_key.split("/")[-1]
+        # megatron tp: column-parallel into the nonlinearity, row-parallel
+        # out of it (same mapping as the dryrun transformer program)
+        if leafname in ("wq", "wk", "wv", "w1"):
+            return P(None, "tp")
+        if leafname in ("wo", "w2"):
+            return P("tp", None)
         return P()
 
     # shard: tokens over dp(+sp along sequence), experts over ep

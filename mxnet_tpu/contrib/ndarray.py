@@ -9,7 +9,8 @@ import sys as _sys
 _mod = _sys.modules[__name__]
 for _name in ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
               "Proposal", "ROIPooling", "CTCLoss", "ctc_loss", "fft",
-              "ifft", "quantize", "dequantize", "count_sketch"):
+              "ifft", "quantize", "dequantize", "count_sketch",
+              "SwitchMoE"):
     if _registry.exists(_name):
         _opdef = _registry.get(_name)
 

@@ -739,3 +739,56 @@ register(
         aliases=("_contrib_count_sketch",),
     )
 )
+
+
+# --------------------------------------------------------------------------
+# SwitchMoE: top-1 mixture-of-experts FFN as a Symbol op
+# --------------------------------------------------------------------------
+def _switch_moe(attrs, ins, is_train):
+    """Expose parallel/moe.py's Switch-MoE through the Symbol/Module API
+    (beyond-reference capability, SURVEY §2.3 expert-parallel row). Two
+    outputs: the routed FFN result and the scalar-ish [1] load-balance
+    aux loss (add it to the training objective via MakeLoss)."""
+    from ..parallel.moe import switch_moe
+
+    data, gate_w, w_up, w_down = ins
+    y, aux = switch_moe(
+        {"gate_w": gate_w, "w_up": w_up, "w_down": w_down},
+        data,
+        capacity_factor=float(attrs.get("capacity_factor", 1.25)),
+    )
+    return [y, aux.reshape(1)]
+
+
+def _switch_moe_infer(attrs, in_shapes):
+    data, gate, up, down = in_shapes
+    if data is None or len(data) != 2:
+        raise MXNetError("SwitchMoE: data must be [tokens, d_model] "
+                         "(Reshape (B,T,D) inputs to (B*T, D))")
+    d_model = data[1]
+    num_experts = int(attrs["num_experts"])
+    d_hidden = int(attrs["num_hidden"])
+    if d_hidden <= 0:
+        # a 0 width would silently infer empty expert weights and train
+        # the MoE branch as a no-op
+        raise MXNetError("SwitchMoE: num_hidden must be set (> 0)")
+    return (
+        [tuple(data), (d_model, num_experts),
+         (num_experts, d_model, d_hidden), (num_experts, d_hidden, d_model)],
+        [tuple(data), (1,)],
+        [],
+    )
+
+
+register(
+    OpDef(
+        "_contrib_SwitchMoE",
+        _switch_moe,
+        arguments=("data", "gate_weight", "up_weight", "down_weight"),
+        outputs=("output", "aux_loss"),
+        defaults={"num_experts": 8, "num_hidden": 0,
+                  "capacity_factor": 1.25},
+        infer_shape=_switch_moe_infer,
+        aliases=("SwitchMoE",),
+    )
+)

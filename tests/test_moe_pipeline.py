@@ -101,6 +101,37 @@ def test_moe_gradients_flow_when_sharded():
             rtol=5e-5, atol=5e-5, err_msg=k)
 
 
+def test_switch_moe_symbol_op_module_fit():
+    """MoE through the reference-style API: a Module whose hidden layer
+    is the _contrib_SwitchMoE symbol op, trained with Module.fit."""
+    import mxnet_tpu as mx
+
+    E, D, H = 4, 16, 32
+    data = mx.sym.Variable("data")
+    moe = mx.contrib.symbol.SwitchMoE(
+        data, mx.sym.Variable("gate_weight"),
+        mx.sym.Variable("up_weight"), mx.sym.Variable("down_weight"),
+        num_experts=E, num_hidden=H, capacity_factor=2.0, name="moe")
+    fc = mx.sym.FullyConnected(moe[0], num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    args, outs, _ = net.infer_shape(data=(32, D), softmax_label=(32,))
+    assert outs == [(32, 2)]
+    d = dict(zip(net.list_arguments(), args))
+    assert d["up_weight"] == (E, D, H) and d["down_weight"] == (E, H, D)
+
+    r = np.random.RandomState(0)
+    X = r.randn(128, D).astype(np.float32)
+    yl = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, yl, batch_size=32)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            initializer=mx.init.Uniform(0.3),
+            optimizer_params={"learning_rate": 0.5})
+    m = mx.metric.Accuracy()
+    assert dict(mod.score(it, m))["accuracy"] > 0.9
+
+
 def test_moe_transformer_trains():
     """The flagship LM with MoE FFN layers: loss (incl. load-balance aux)
     falls under SGD, and expert weights receive gradients."""

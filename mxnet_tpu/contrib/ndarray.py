@@ -7,10 +7,9 @@ from ..ops import registry as _registry
 import sys as _sys
 
 _mod = _sys.modules[__name__]
-for _name in ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
-              "Proposal", "ROIPooling", "CTCLoss", "ctc_loss", "fft",
-              "ifft", "quantize", "dequantize", "count_sketch",
-              "SwitchMoE"):
+from .ops import CONTRIB_OP_EXPORTS
+
+for _name in CONTRIB_OP_EXPORTS:
     if _registry.exists(_name):
         _opdef = _registry.get(_name)
 

@@ -769,9 +769,11 @@ def _switch_moe_infer(attrs, in_shapes):
     num_experts = int(attrs["num_experts"])
     d_hidden = int(attrs["num_hidden"])
     if d_hidden <= 0:
-        # a 0 width would silently infer empty expert weights and train
-        # the MoE branch as a no-op
-        raise MXNetError("SwitchMoE: num_hidden must be set (> 0)")
+        # ValueError (not MXNetError) so the message survives the infer
+        # fixpoint loop, which treats MXNetError as "not resolvable yet"
+        # — a 0 width would otherwise silently infer empty expert
+        # weights and train the MoE branch as a no-op
+        raise ValueError("SwitchMoE: num_hidden must be set (> 0)")
     return (
         [tuple(data), (d_model, num_experts),
          (num_experts, d_model, d_hidden), (num_experts, d_hidden, d_model)],
@@ -791,4 +793,13 @@ register(
         infer_shape=_switch_moe_infer,
         aliases=("SwitchMoE",),
     )
+)
+
+
+# Single source of truth for the names contrib/{symbol,ndarray}.py expose
+# (keeps the two frontends from drifting when an op is added).
+CONTRIB_OP_EXPORTS = (
+    "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection", "Proposal",
+    "ROIPooling", "CTCLoss", "ctc_loss", "fft", "ifft", "quantize",
+    "dequantize", "count_sketch", "SwitchMoE",
 )

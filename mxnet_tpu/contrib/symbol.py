@@ -1,15 +1,13 @@
 """mx.contrib.symbol — contrib ops as Symbol functions (parity: reference
 mx.contrib.symbol, used by the SSD/RCNN example symbols)."""
 from . import ops as _ops  # noqa: F401  (registers contrib ops)
+from .ops import CONTRIB_OP_EXPORTS
 from ..symbol import _make_symbol_function, _init_symbol_module as _reinit
 from ..ops import registry as _registry
 import sys as _sys
 
 _mod = _sys.modules[__name__]
-for _name in ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
-              "Proposal", "ROIPooling", "CTCLoss", "ctc_loss", "fft",
-              "ifft", "quantize", "dequantize", "count_sketch",
-              "SwitchMoE"):
+for _name in CONTRIB_OP_EXPORTS:
     if _registry.exists(_name):
         setattr(_mod, _name, _make_symbol_function(_registry.get(_name)))
 _reinit()

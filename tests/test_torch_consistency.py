@@ -1,0 +1,191 @@
+"""Cross-framework consistency: core NN ops vs torch (independent oracle).
+
+Reference analog: tests/python/gpu/test_operator_gpu.py
+check_consistency — the same op run on two independent backends must
+agree on outputs AND input gradients. Here the second backend is
+torch-cpu (bundled in the image), which shares no code with the
+jax/XLA path, so a systematic convention error (pad/stride/dilate/group
+handling, BN statistics, pooling windows) cannot hide in both.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+_rng = np.random.RandomState(42)
+
+
+def _mx_fwd_bwd(op, inputs, attrs, n_data_grads=1):
+    """Run op imperatively with autograd; return (out, grads[:n])."""
+    from mxnet_tpu.contrib import autograd as ag
+
+    arrs = [mx.nd.array(v) for v in inputs]
+    grads = [mx.nd.zeros(a.shape) for a in arrs]
+    ag.mark_variables(arrs, grads)
+    with ag.train_section():
+        out = getattr(mx.nd, op)(*arrs, **attrs)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+    ag.backward([out], [mx.nd.ones(out.shape)])
+    return out.asnumpy(), [g.asnumpy() for g in grads[:n_data_grads]]
+
+
+def _torch_fwd_bwd(fn, inputs, n_data_grads=1):
+    ts = [torch.tensor(v, requires_grad=True) for v in inputs]
+    out = fn(*ts)
+    out.backward(torch.ones_like(out))
+    return out.detach().numpy(), [t.grad.numpy() for t in ts[:n_data_grads]]
+
+
+def _close(a, b, rtol=2e-4, atol=2e-4, msg=""):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol, err_msg=msg)
+
+
+@pytest.mark.parametrize(
+    "stride,pad,dilate,groups",
+    [
+        ((1, 1), (0, 0), (1, 1), 1),
+        ((2, 2), (1, 1), (1, 1), 1),
+        ((1, 1), (2, 2), (2, 2), 1),
+        ((2, 1), (1, 2), (1, 1), 1),
+        ((1, 1), (1, 1), (1, 1), 4),
+    ],
+    ids=["plain", "stride2pad1", "dilate2", "asym", "groups4"],
+)
+def test_convolution_matches_torch(stride, pad, dilate, groups):
+    x = _rng.randn(2, 8, 13, 11).astype(np.float32)
+    w = _rng.randn(12, 8 // groups, 3, 3).astype(np.float32)
+    b = _rng.randn(12).astype(np.float32)
+    out, grads = _mx_fwd_bwd(
+        "Convolution", [x, w, b],
+        dict(num_filter=12, kernel=(3, 3), stride=stride, pad=pad,
+             dilate=dilate, num_group=groups), n_data_grads=3)
+    t_out, t_grads = _torch_fwd_bwd(
+        lambda xt, wt, bt: F.conv2d(xt, wt, bt, stride=stride,
+                                    padding=pad, dilation=dilate,
+                                    groups=groups),
+        [x, w, b], n_data_grads=3)
+    _close(out, t_out, msg="fwd")
+    for g, tg, name in zip(grads, t_grads, "xwb"):
+        _close(g, tg, msg="grad_" + name)
+
+
+@pytest.mark.parametrize(
+    "stride,pad,adj",
+    [((1, 1), (0, 0), (0, 0)), ((2, 2), (1, 1), (0, 0)),
+     ((2, 2), (1, 1), (1, 1))],
+    ids=["plain", "stride2", "adj1"],
+)
+def test_deconvolution_matches_torch(stride, pad, adj):
+    x = _rng.randn(2, 6, 7, 7).astype(np.float32)
+    w = _rng.randn(6, 5, 3, 3).astype(np.float32)  # (in, out, kh, kw)
+    out, grads = _mx_fwd_bwd(
+        "Deconvolution", [x, w],
+        dict(num_filter=5, kernel=(3, 3), stride=stride, pad=pad,
+             adj=adj, no_bias=True), n_data_grads=2)
+    t_out, t_grads = _torch_fwd_bwd(
+        lambda xt, wt: F.conv_transpose2d(
+            xt, wt, stride=stride, padding=pad, output_padding=adj),
+        [x, w], n_data_grads=2)
+    _close(out, t_out, msg="fwd")
+    _close(grads[0], t_grads[0], msg="grad_x")
+    _close(grads[1], t_grads[1], msg="grad_w")
+
+
+def test_maxpool_matches_torch():
+    x = _rng.randn(2, 4, 10, 10).astype(np.float32)
+    out, grads = _mx_fwd_bwd(
+        "Pooling", [x],
+        dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max"))
+    t_out, t_grads = _torch_fwd_bwd(
+        lambda xt: F.max_pool2d(xt, 3, stride=2, padding=1), [x])
+    _close(out, t_out, msg="fwd")
+    _close(grads[0], t_grads[0], msg="grad")
+
+
+def test_avgpool_matches_torch():
+    # MXNet avg pooling divides by the FULL window (pad included):
+    # torch's count_include_pad=True convention
+    x = _rng.randn(2, 4, 10, 10).astype(np.float32)
+    out, grads = _mx_fwd_bwd(
+        "Pooling", [x],
+        dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="avg"))
+    t_out, t_grads = _torch_fwd_bwd(
+        lambda xt: F.avg_pool2d(xt, 3, stride=2, padding=1,
+                                count_include_pad=True), [x])
+    _close(out, t_out, msg="fwd")
+    _close(grads[0], t_grads[0], msg="grad")
+
+
+def test_batchnorm_training_matches_torch():
+    x = _rng.randn(6, 5, 4, 4).astype(np.float32)
+    gamma = _rng.rand(5).astype(np.float32) + 0.5
+    beta = _rng.randn(5).astype(np.float32)
+    from mxnet_tpu.contrib import autograd as ag
+
+    xa, ga, ba = (mx.nd.array(v) for v in (x, gamma, beta))
+    moving_mean = mx.nd.zeros((5,))
+    moving_var = mx.nd.ones((5,))
+    mx_grads = [mx.nd.zeros(v.shape) for v in (xa, ga, ba)]
+    ag.mark_variables([xa, ga, ba], mx_grads)
+    with ag.train_section():
+        out = mx.nd.BatchNorm(xa, ga, ba, moving_mean, moving_var,
+                              fix_gamma=False, eps=1e-5)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+    ag.backward([out], [mx.nd.ones(out.shape)])
+
+    xt = torch.tensor(x, requires_grad=True)
+    gt = torch.tensor(gamma, requires_grad=True)
+    bt = torch.tensor(beta, requires_grad=True)
+    t_out = F.batch_norm(xt, torch.zeros(5), torch.ones(5), gt, bt,
+                         training=True, eps=1e-5)
+    t_out.backward(torch.ones_like(t_out))
+
+    _close(out.asnumpy(), t_out.detach().numpy(), msg="fwd")
+    _close(mx_grads[0].asnumpy(), xt.grad.numpy(), rtol=1e-3, atol=1e-3,
+           msg="grad_x")
+    _close(mx_grads[1].asnumpy(), gt.grad.numpy(), rtol=1e-3, atol=1e-3,
+           msg="grad_gamma")
+    _close(mx_grads[2].asnumpy(), bt.grad.numpy(), msg="grad_beta")
+
+
+def test_fullyconnected_matches_torch():
+    x = _rng.randn(4, 10).astype(np.float32)
+    w = _rng.randn(7, 10).astype(np.float32)
+    b = _rng.randn(7).astype(np.float32)
+    out, grads = _mx_fwd_bwd(
+        "FullyConnected", [x, w, b], dict(num_hidden=7), n_data_grads=3)
+    t_out, t_grads = _torch_fwd_bwd(
+        lambda xt, wt, bt: F.linear(xt, wt, bt), [x, w, b], n_data_grads=3)
+    _close(out, t_out, msg="fwd")
+    for g, tg, name in zip(grads, t_grads, "xwb"):
+        _close(g, tg, msg="grad_" + name)
+
+
+def test_softmax_ce_loss_matches_torch():
+    x = _rng.randn(8, 11).astype(np.float32)
+    label = _rng.randint(0, 11, 8).astype(np.float32)
+    out = mx.nd.softmax_cross_entropy(mx.nd.array(x), mx.nd.array(label))
+    t = F.cross_entropy(torch.tensor(x), torch.tensor(label).long(),
+                        reduction="sum")
+    _close(np.asarray(out.asnumpy()).reshape(()), t.numpy(), msg="loss")
+
+
+def test_leakyrelu_elu_match_torch():
+    x = _rng.randn(3, 6).astype(np.float32)
+    out, grads = _mx_fwd_bwd("LeakyReLU", [x],
+                             dict(act_type="leaky", slope=0.1))
+    t_out, t_grads = _torch_fwd_bwd(
+        lambda xt: F.leaky_relu(xt, 0.1), [x])
+    _close(out, t_out)
+    _close(grads[0], t_grads[0])
+    out, grads = _mx_fwd_bwd("LeakyReLU", [x],
+                             dict(act_type="elu", slope=0.3))
+    t_out, t_grads = _torch_fwd_bwd(
+        lambda xt: torch.where(xt > 0, xt, 0.3 * (torch.exp(xt) - 1)), [x])
+    _close(out, t_out)
+    _close(grads[0], t_grads[0])

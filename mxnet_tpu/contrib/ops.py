@@ -762,8 +762,12 @@ def _switch_moe(attrs, ins, is_train):
 
 def _switch_moe_infer(attrs, in_shapes):
     data, gate, up, down = in_shapes
-    if data is None or len(data) != 2:
-        raise MXNetError("SwitchMoE: data must be [tokens, d_model] "
+    if data is None:
+        raise MXNetError("SwitchMoE: data shape required")  # resolvable later
+    if len(data) != 2:
+        # ValueError: a known-but-wrong rank is a hard contract violation
+        # that must survive the infer fixpoint loop (like num_hidden below)
+        raise ValueError("SwitchMoE: data must be [tokens, d_model] "
                          "(Reshape (B,T,D) inputs to (B*T, D))")
     d_model = data[1]
     num_experts = int(attrs["num_experts"])

@@ -24,6 +24,30 @@ from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
 
 
+def _local_rows(arr):
+    """This process's rows of a (possibly multi-process) jax.Array.
+    Single-process arrays pass through untouched; for a process-spanning
+    mesh each worker's outputs/metrics cover its own data shard
+    (reference dist semantics: per-worker metric over the worker's
+    partition). Replicated (incl. 0-d) outputs come back as one copy,
+    not one per local device."""
+    if getattr(arr, "is_fully_addressable", True):
+        return arr
+    import numpy as _np
+
+    if arr.is_fully_replicated or arr.ndim == 0:
+        return _np.asarray(arr.addressable_shards[0].data)
+    # batch-sharded: dedupe by shard index (a device may replicate a
+    # slice other local devices already hold), then stitch in row order
+    by_index = {}
+    for s in arr.addressable_shards:
+        key = tuple((sl.start, sl.stop) for sl in s.index)
+        by_index.setdefault(key, s.data)
+    ordered = sorted(by_index.items(),
+                     key=lambda kv: (kv[0][0][0] or 0) if kv[0] else 0)
+    return _np.concatenate([_np.asarray(d) for _, d in ordered], axis=0)
+
+
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
@@ -90,6 +114,7 @@ class Module(BaseModule):
         self._fused_opt = None
         self._fused_batch = None
         self._fused_outputs = None
+        self._fused_outs_raw = None
         self._fused_t = 0
         self._fused_exec_stale = False
 
@@ -351,12 +376,35 @@ class Module(BaseModule):
         )
 
     def _init_fused(self):
+        import jax
         from jax.sharding import Mesh
 
         from ..parallel.train_step import ShardedTrainStep
 
+        multiworker = (self._kvstore is not None
+                       and "dist" in self._kvstore.type
+                       and self._kvstore.num_workers > 1)
         if self._mesh is not None:
             mesh = self._mesh
+            if multiworker:
+                procs = {d.process_index for d in mesh.devices.flat}
+                if len(procs) < jax.process_count():
+                    # a process-local mesh would psum only locally and
+                    # the workers would silently train unsynchronized
+                    raise MXNetError(
+                        "dist kvstore %r with a mesh spanning %d of %d "
+                        "processes: the fused step's gradient psum would "
+                        "skip the other workers. Build the mesh from "
+                        "jax.devices() (all processes), or drop the "
+                        "explicit mesh." % (self._kvstore.type,
+                                            len(procs),
+                                            jax.process_count()))
+        elif multiworker:
+            # dist fused path MUST span every process's devices (found
+            # by the fault-recovery test: with a local mesh a dead peer
+            # did not even stall the survivor). Reference semantics:
+            # dist_device_sync reduces across ALL workers every step.
+            mesh = Mesh(np.asarray(jax.devices()), ("dp",))
         else:
             devices = [c.jax_device for c in self._context]
             mesh = Mesh(np.asarray(devices), ("dp",))
@@ -366,6 +414,14 @@ class Module(BaseModule):
             data_names=self._data_names, label_names=self._label_names,
         ).compile()
         self._fused_owner = self
+        if multiworker:
+            # ranks may have initialized params independently; adopt the
+            # kvstore's root-broadcast values (kv.init stored rank 0's)
+            # so the replicated device_put sees identical bytes on every
+            # process — reference dist init semantics (all workers start
+            # from rank 0's weights)
+            for idx, name in enumerate(self._exec_group.param_names):
+                self._kvstore.pull(idx, out=self._arg_params[name])
         self._fused_params, self._fused_aux = self._fused_trainer.place_params(
             self._arg_params, self._aux_params
         )
@@ -377,12 +433,25 @@ class Module(BaseModule):
         import jax
 
         sharding = self._fused_trainer.batch_sharding()
+        multiproc = not all(
+            d.process_index == jax.process_index()
+            for d in self._fused_trainer.mesh.devices.flat)
+
+        def _put(arr):
+            if multiproc:
+                # this process contributes its LOCAL rows of the global
+                # batch (reference: each dist worker reads its own data
+                # shard; global batch = local batch x num_workers)
+                return jax.make_array_from_process_local_data(
+                    sharding, arr.asnumpy())
+            return jax.device_put(arr.asnumpy(), sharding)
+
         batch = {}
         for name, arr in zip(self._data_names, data_batch.data):
-            batch[name] = jax.device_put(arr.asnumpy(), sharding)
+            batch[name] = _put(arr)
         if self._label_names and data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
-                batch[name] = jax.device_put(arr.asnumpy(), sharding)
+                batch[name] = _put(arr)
         return batch
 
     def _ensure_exec_params(self):
@@ -424,10 +493,12 @@ class Module(BaseModule):
             # defer: the fused step runs fwd+bwd+update at update()
             self._fused_batch = data_batch
             self._fused_outputs = None
+            self._fused_outs_raw = None
             return
         # executor path (eval/predict): drop any stale fused outputs so
         # get_outputs/update_metric serve THIS forward's results
         self._fused_outputs = None
+        self._fused_outs_raw = None
         self._fused_batch = None
         self._ensure_exec_params()
         self._exec_group.forward(data_batch, is_train)
@@ -475,7 +546,11 @@ class Module(BaseModule):
                 batch, lr=lr, t=owner._fused_t,
             )
             owner._fused_params, owner._fused_aux, owner._fused_opt = p, a, s
-            self._fused_outputs = [nd.NDArray(o) for o in outs]
+            # raw jax.Arrays; _local_rows conversion (a host transfer in
+            # multi-process runs) happens lazily on first read so loops
+            # that never touch outputs don't stall the async pipeline
+            self._fused_outs_raw = list(outs)
+            self._fused_outputs = None
             self._fused_batch = None
             owner._fused_exec_stale = True
             self._fused_exec_stale = True
@@ -492,11 +567,18 @@ class Module(BaseModule):
                 kvstore=self._kvstore
             )
 
+    def _materialized_fused_outputs(self):
+        if self._fused_outputs is None and self._fused_outs_raw is not None:
+            self._fused_outputs = [
+                nd.NDArray(_local_rows(o)) for o in self._fused_outs_raw]
+        return self._fused_outputs
+
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
         if self._fused_trainer is not None:
-            if self._fused_outputs is not None:
-                return self._fused_outputs
+            outs = self._materialized_fused_outputs()
+            if outs is not None:
+                return outs
             if self._fused_batch is not None:
                 # forward() was deferred and update() has not run yet:
                 # serve outputs through the executor path
@@ -512,9 +594,11 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        if self._fused_trainer is not None and self._fused_outputs is not None:
-            eval_metric.update(labels, self._fused_outputs)
-            return
+        if self._fused_trainer is not None:
+            outs = self._materialized_fused_outputs()
+            if outs is not None:
+                eval_metric.update(labels, outs)
+                return
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):

@@ -1,0 +1,99 @@
+"""Worker body for the dist fault-recovery integration test
+(test_dist_recovery.py): a 2-process data-parallel training that loses
+rank 1 mid-run on the first attempt.
+
+Each attempt: join the JAX distributed runtime, train a tiny MLP with
+Module.fit over a process-spanning dp mesh (kvstore dist_device_sync =
+fused psum step), checkpointing every epoch from rank 0. On attempt 1,
+rank 1 hard-exits after epoch 1's checkpoint (fault injection); rank 0
+either errors out of the collective or wedges — both signals the
+supervising watchdog turns into a group kill + restart. Attempt 2
+resumes from the newest checkpoint and finishes all epochs.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# env var alone does not reliably win over the container's accelerator
+# plugin (see __graft_entry__._force_cpu_mesh_platform) — the config
+# update must land before any backend touch
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def latest_epoch(prefix):
+    best = 0
+    for p in glob.glob("%s-*.params" % prefix):
+        m = re.match(r".*-(\d+)\.params$", p)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--num-epochs", type=int, default=4)
+    args = ap.parse_args()
+    prefix = os.path.join(args.dir, "ckpt")
+    fault_flag = os.path.join(args.dir, "fault_injected")
+
+    kv = mx.kvstore.create("dist_device_sync")
+    rank = kv.rank
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 16).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    begin = latest_epoch(prefix)
+    if begin:
+        sym, arg, aux = mx.model.load_checkpoint(prefix, begin)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.set_params(arg, aux)
+
+    callbacks = []
+    if rank == 0:
+        callbacks.append(mx.callback.do_checkpoint(prefix))
+
+    def fault(epoch, *_):
+        if rank == 1 and epoch == 1 and not os.path.exists(fault_flag):
+            open(fault_flag, "w").close()
+            os._exit(23)
+
+    callbacks.append(fault)
+    mod.fit(it, num_epoch=args.num_epochs, begin_epoch=begin,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            kvstore=kv, epoch_end_callback=callbacks)
+
+    if rank == 0:
+        acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+        with open(os.path.join(args.dir, "result.json"), "w") as f:
+            json.dump({"final_epoch": latest_epoch(prefix),
+                       "accuracy": float(acc),
+                       "resumed_from": begin}, f)
+    print("[dist_recovery rank %d] done (begin=%d)" % (rank, begin),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

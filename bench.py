@@ -63,36 +63,71 @@ def peak_tflops_for_kind(kind):
 
 
 def calibrate_matmul_tflops(jax, jnp):
-    """Measure achieved TFLOP/s on a chained bf16 matmul with KNOWN flops.
+    """Measure achieved TFLOP/s on chained bf16 matmuls with KNOWN flops.
 
     Independent cross-check on the spec-sheet peak: if the device_kind
     lookup is wrong (unknown kind, tunnel relabeling), the calibration
     number becomes the MFU denominator, so the reported MFU can never be
-    garbage relative to what the chip demonstrably sustains."""
-    n, iters = 4096, 32
+    garbage relative to what the chip demonstrably sustains.
+
+    Two-point slope method: through the remote-access tunnel each
+    dispatch carries a fixed latency (measured ~10-25 ms) that made a
+    single short measurement read ~50% of the chip's real throughput.
+    Timing two chain lengths and dividing the flop delta by the time
+    delta cancels every per-call constant, leaving pure compute rate
+    (validated on v5e: single-shot 93 TFLOP/s vs slope 180 TFLOP/s at
+    the 197 spec)."""
+    n = 4096
+
+    def make(iters):
+        def chain(x, w):
+            def body(x, _):
+                return jnp.dot(x, w, preferred_element_type=jnp.bfloat16), None
+            y, _ = jax.lax.scan(body, x, None, length=iters)
+            # Reduce to a scalar ON DEVICE: timing must end with a host
+            # fetch of a tiny value (see _force) -- fetching the matrix
+            # would time the transfer, and block_until_ready alone
+            # returns early through the axon tunnel.
+            return y.astype(jnp.float32).mean()
+        return jax.jit(chain), iters
+
     x = jnp.ones((n, n), jnp.bfloat16)
     w = jnp.ones((n, n), jnp.bfloat16)
+    times = {}
+    for f, iters in (make(64), make(256)):
+        float(f(x, w))  # compile + warm, forced to completion
+        t0 = time.perf_counter()
+        reps = 2
+        for _ in range(reps):
+            y = f(x, w)
+        float(y)  # host round-trip: the only trustworthy completion signal
+        times[iters] = (time.perf_counter() - t0) / reps
+    d_flops = 2.0 * n * n * n * (256 - 64)
+    d_time = times[256] - times[64]
+    if d_time <= 0:
+        return None
+    return d_flops / d_time / 1e12
 
-    def chain(x, w):
-        def body(x, _):
-            return jnp.dot(x, w, preferred_element_type=jnp.bfloat16), None
-        y, _ = jax.lax.scan(body, x, None, length=iters)
-        # Reduce to a scalar ON DEVICE: timing must end with a host fetch
-        # of a tiny value (see _force) -- fetching the full matrix would
-        # time the transfer, and block_until_ready alone returns early
-        # through the axon tunnel (measured 85,000 "TFLOP/s" that way).
-        return y.astype(jnp.float32).mean()
 
-    f = jax.jit(chain)
-    float(f(x, w))  # compile + warm, forced to completion
+def measure_dispatch_overhead_ms(jax, jnp, params):
+    """Per-call fixed cost of dispatching through the tunnel, estimated
+    with a trivial donated identity over the SAME pytree the train step
+    carries (arg marshalling scales with leaf count). Reported alongside
+    the wall-clock numbers so est_device_* rows can subtract it."""
+    leaves = {k: v for k, v in params.items()}
+
+    @jax.jit
+    def ident(p):
+        return {k: v + 0 for k, v in p.items()}
+
+    out = ident(leaves)
+    _force(out)
     t0 = time.perf_counter()
-    reps = 3
+    reps = 8
     for _ in range(reps):
-        y = f(x, w)
-    float(y)  # host round-trip: the only trustworthy completion signal
-    dt = time.perf_counter() - t0
-    flops = 2.0 * n * n * n * iters * reps
-    return flops / dt / 1e12
+        out = ident(out)
+    _force(out)
+    return 1000.0 * (time.perf_counter() - t0) / reps
 
 
 def _force(tree):
@@ -325,7 +360,13 @@ def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False):
         params, moms, aux = run(params, moms, aux, data, label)
     _force(params)  # scalar host fetch; block_until_ready lies via axon
     dt = time.perf_counter() - t0
-    return batch * steps / dt, 1000.0 * dt / steps, flops_per_step
+
+    overhead_ms = None
+    try:
+        overhead_ms = measure_dispatch_overhead_ms(jax, jnp, params)
+    except Exception as e:
+        log("dispatch-overhead probe failed: %s" % e)
+    return batch * steps / dt, 1000.0 * dt / steps, flops_per_step, overhead_ms
 
 
 def mfu_fields(prefix, step_ms, flops_per_step, peak_tflops):
@@ -371,8 +412,13 @@ def main():
         stage("calibrate")
         try:
             calib_tflops = calibrate_matmul_tflops(jax, jnp)
-            log("calibration: %.1f TFLOP/s bf16 matmul (spec %s for %r)"
-                % (calib_tflops, spec_peak, kind))
+            if calib_tflops is None:
+                log("calibration degenerate: non-positive time delta "
+                    "between chain lengths (timing jitter); falling back "
+                    "to spec peak")
+            else:
+                log("calibration: %.1f TFLOP/s bf16 matmul (spec %s for %r)"
+                    % (calib_tflops, spec_peak, kind))
         except Exception as e:
             log("calibration failed: %s" % e)
     # Denominator for MFU: the spec peak for the identified chip, unless
@@ -383,7 +429,7 @@ def main():
         peak = calib_tflops
 
     stage("build")
-    img_s, step_ms, flops = run_resnet50(jax, jnp, BATCH, STEPS, WARMUP)
+    img_s, step_ms, flops, ovh = run_resnet50(jax, jnp, BATCH, STEPS, WARMUP)
 
     out = {
         "metric": METRIC,
@@ -403,28 +449,49 @@ def main():
         out["calib_matmul_tflops"] = round(calib_tflops, 1)
     out.update(mfu_fields("", step_ms, flops, peak))
 
+    def _device_est(prefix, step_ms_row, flops_row, overhead_ms):
+        """Tunnel-corrected estimate: wall-clock rows stay primary; the
+        measured fixed dispatch latency (an artifact of the remote test
+        rig, not of the framework or chip) is subtracted for an
+        est_device_* view, clearly labeled as an estimate."""
+        if not overhead_ms or overhead_ms >= step_ms_row:
+            return {}
+        est = step_ms_row - overhead_ms
+        fields = {prefix + "dispatch_overhead_ms": round(overhead_ms, 2),
+                  prefix + "est_device_step_ms": round(est, 2)}
+        m = mfu_fields(prefix + "est_device_", est, flops_row, peak)
+        m.pop(prefix + "est_device_tflops_per_step", None)
+        fields.update(m)
+        return fields
+
+    out.update(_device_est("", step_ms, flops, ovh))
+
     # Secondary large-batch row: batch 32 at ~1 ms/step is latency-bound
     # and says little about sustained utilization.
     if on_tpu and BATCH2 > BATCH:
         try:
-            img_s2, step_ms2, flops2 = run_resnet50(
+            img_s2, step_ms2, flops2, ovh2 = run_resnet50(
                 jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP)
             out["batch%d_images_per_sec" % BATCH2] = round(img_s2, 2)
             out["batch%d_step_ms" % BATCH2] = round(step_ms2, 2)
             out.update(mfu_fields(
                 "batch%d_" % BATCH2, step_ms2, flops2, peak))
+            out.update(_device_est("batch%d_" % BATCH2, step_ms2, flops2,
+                                   ovh2))
         except Exception as e:
             log("batch-%d run failed: %s" % (BATCH2, e))
             out["batch%d_error" % BATCH2] = str(e)[:200]
         # bf16 mixed-precision row (reference fp16 recipe, TPU dtype):
         # this is the configuration the MXU is built for
         try:
-            img_s3, step_ms3, flops3 = run_resnet50(
+            img_s3, step_ms3, flops3, ovh3 = run_resnet50(
                 jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP, bf16=True)
             out["bf16_batch%d_images_per_sec" % BATCH2] = round(img_s3, 2)
             out["bf16_batch%d_step_ms" % BATCH2] = round(step_ms3, 2)
             out.update(mfu_fields(
                 "bf16_batch%d_" % BATCH2, step_ms3, flops3, peak))
+            out.update(_device_est("bf16_batch%d_" % BATCH2, step_ms3,
+                                   flops3, ovh3))
         except Exception as e:
             log("bf16 run failed: %s" % e)
             out["bf16_error"] = str(e)[:200]

@@ -364,8 +364,19 @@ class Module(BaseModule):
         fused ShardedTrainStep (SURVEY §5.8: device-side reduce ≡ in-XLA
         allreduce over the mesh). The executor-group path remains for
         inference, input grads, and the 'local' kvstore."""
-        dp = (self._mesh.shape.get("dp", 1) if self._mesh is not None
-              else len(self._context))
+        if self._mesh is not None:
+            dp = self._mesh.shape.get("dp", 1)
+        elif (kvstore is not None and "dist" in kvstore.type
+                and kvstore.num_workers > 1):
+            # multiworker fused mesh spans jax.devices(); this process
+            # contributes its LOCAL batch rows across its LOCAL devices,
+            # so that is the divisibility that must hold (mirrors the
+            # shape contract of make_array_from_process_local_data)
+            import jax
+
+            dp = jax.local_device_count()
+        else:
+            dp = len(self._context)
         return (
             kvstore is not None
             and "device" in kvstore.type
@@ -408,6 +419,9 @@ class Module(BaseModule):
         else:
             devices = [c.jax_device for c in self._context]
             mesh = Mesh(np.asarray(devices), ("dp",))
+        self._fused_multiproc = not all(
+            d.process_index == jax.process_index()
+            for d in mesh.devices.flat)
         self._fused_trainer = ShardedTrainStep(
             self._symbol, mesh, optimizer=self._optimizer,
             param_specs=self._param_specs,
@@ -433,9 +447,8 @@ class Module(BaseModule):
         import jax
 
         sharding = self._fused_trainer.batch_sharding()
-        multiproc = not all(
-            d.process_index == jax.process_index()
-            for d in self._fused_trainer.mesh.devices.flat)
+        multiproc = getattr(self, "_fused_multiproc", False) or getattr(
+            self._fused_owner, "_fused_multiproc", False)
 
         def _put(arr):
             if multiproc:

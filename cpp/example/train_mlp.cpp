@@ -54,16 +54,8 @@ void LoadDigits(std::vector<float>* X, std::vector<float>* y, size_t* n) {
                .attr("astype")(to_py("float32"))
                .attr("__truediv__")(to_py(16.0));
   Obj yn = ds.attr("target").attr("astype")(to_py("float32"));
-  auto to_vec = [](const Obj& arr, std::vector<float>* out) {
-    Obj b = arr.attr("astype")(to_py("float32")).attr("tobytes")();
-    char* src = nullptr;
-    Py_ssize_t nb = 0;
-    PyBytes_AsStringAndSize(b.get(), &src, &nb);
-    out->resize(static_cast<size_t>(nb) / sizeof(float));
-    std::memcpy(out->data(), src, static_cast<size_t>(nb));
-  };
-  to_vec(Xn, X);
-  to_vec(yn, y);
+  *X = bytes_to_vector(Xn);
+  *y = bytes_to_vector(yn);
   *n = y->size();
   (void)np;
 }
@@ -88,6 +80,7 @@ float Evaluate(Executor* exec, const NDArray& data, const NDArray& label) {
 }  // namespace
 
 int main(int argc, char** argv) {
+  std::setvbuf(stdout, nullptr, _IOLBF, 0);  // progress visible in pipes
   for (int i = 1; i < argc; ++i)
     if (std::string(argv[i]) == "--cpu") Runtime::UsePlatform("cpu");
 
@@ -189,8 +182,38 @@ int main(int argc, char** argv) {
   std::printf("checkpoint-roundtrip: %s\n",
               (reload_acc == final_acc) ? "exact" : "MISMATCH");
 
+  // Standalone inference via the Predictor (c_predict_api analog):
+  // pack a single-file bundle, serve it, score in plain C++.
+  Obj pred_mod = Obj::Steal(PyImport_ImportModule("mxnet_tpu.predict"),
+                            "import mxnet_tpu.predict");
+  Obj pdict = Obj::Steal(PyDict_New(), "dict");
+  for (auto& kv : args)
+    if (kv.first != "data" && kv.first != "softmax_label")
+      PyDict_SetItemString(pdict.get(), kv.first.c_str(),
+                           kv.second.py().get());
+  pred_mod.attr("export_bundle")(to_py("/tmp/mxtpu_cpp_mlp.bundle"),
+                                 net.py(), pdict);
+  Predictor pred = Predictor::FromBundle(
+      "/tmp/mxtpu_cpp_mlp.bundle", {{"data", Shape{val_n, dim}}});
+  pred.SetInput("data", val_x.AsVector(), Shape{val_n, dim});
+  pred.Forward();
+  std::vector<float> probs = pred.GetOutput(0);
+  std::vector<float> labels = val_y.AsVector();
+  size_t n_classes = probs.size() / val_n, hits = 0;
+  for (size_t i = 0; i < val_n; ++i) {
+    size_t best = 0;
+    for (size_t c = 1; c < n_classes; ++c)
+      if (probs[i * n_classes + c] > probs[i * n_classes + best]) best = c;
+    hits += (best == static_cast<size_t>(labels[i]));
+  }
+  float pred_acc = static_cast<float>(hits) / val_n;
+  std::printf("predictor-accuracy: %.4f\n", pred_acc);
+
   delete exec;
   delete val_exec;
   delete reload_exec;
-  return (final_acc > 0.90f && reload_acc == final_acc) ? 0 : 1;
+  return (final_acc > 0.90f && reload_acc == final_acc &&
+          pred_acc == final_acc)
+             ? 0
+             : 1;
 }

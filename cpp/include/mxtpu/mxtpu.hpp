@@ -311,6 +311,33 @@ class Shape {
   std::vector<size_t> dims_;
 };
 
+// Shared host-buffer bridges (one definition each — used by NDArray,
+// Predictor and example code alike).
+inline Obj np_array_from_buffer(const mx_float* data, size_t size,
+                                const Shape& shape) {
+  Obj bytes = Obj::Steal(
+      PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(data),
+          static_cast<Py_ssize_t>(size * sizeof(mx_float))),
+      "bytes");
+  Obj np = Runtime::Get().np();
+  Obj flat = np.attr("frombuffer")(bytes, to_py("float32"));
+  return flat.attr("reshape")(shape.py_tuple());
+}
+
+// Extract a float32 copy of any array-like (NDArray or numpy) python
+// object into a C++ vector.
+inline std::vector<mx_float> bytes_to_vector(const Obj& array_like) {
+  Obj b = array_like.attr("astype")(to_py("float32")).attr("tobytes")();
+  char* src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(b.get(), &src, &n) != 0)
+    ThrowPythonError("tobytes");
+  std::vector<mx_float> v(static_cast<size_t>(n) / sizeof(mx_float));
+  std::memcpy(v.data(), src, static_cast<size_t>(n));
+  return v;
+}
+
 // ---------------------------------------------------------------------------
 // Context (reference: cpp-package/include/mxnet-cpp/base.h DeviceType)
 // ---------------------------------------------------------------------------
@@ -367,7 +394,7 @@ class NDArray {
 
   // --- host <-> device buffer movement (explicit, like the reference) ---
   void SyncCopyFromCPU(const mx_float* data, size_t size) {
-    Obj arr = np_from_buffer(data, size, GetShape());
+    Obj arr = np_array_from_buffer(data, size, GetShape());
     // a[:] = arr  (in-place rebind; python __setitem__ handles staging)
     set_all(arr);
   }
@@ -375,22 +402,13 @@ class NDArray {
     SyncCopyFromCPU(data.data(), data.size());
   }
   void SyncCopyToCPU(mx_float* data, size_t size) const {
-    Obj b = h_.attr("asnumpy")()
-                .attr("astype")(mxtpu::to_py("float32"))
-                .attr("tobytes")();
-    char* src = nullptr;
-    Py_ssize_t n = 0;
-    if (PyBytes_AsStringAndSize(b.get(), &src, &n) != 0)
-      ThrowPythonError("tobytes");
-    size_t want = size * sizeof(mx_float);
-    if (static_cast<size_t>(n) < want)
+    std::vector<mx_float> v = bytes_to_vector(h_.attr("asnumpy")());
+    if (v.size() < size)
       throw std::runtime_error("SyncCopyToCPU: array smaller than request");
-    std::memcpy(data, src, want);
+    std::memcpy(data, v.data(), size * sizeof(mx_float));
   }
   std::vector<mx_float> AsVector() const {
-    std::vector<mx_float> out(Size());
-    SyncCopyToCPU(out.data(), out.size());
-    return out;
+    return bytes_to_vector(h_.attr("asnumpy")());
   }
 
   Shape GetShape() const { return Shape(h_.attr("shape")); }
@@ -471,19 +489,9 @@ class NDArray {
  private:
   static Obj nd_mod() { return Runtime::Get().mx_attr("nd"); }
 
-  static Obj np_from_buffer(const mx_float* data, size_t size,
-                            const Shape& shape) {
-    Obj bytes = Obj::Steal(
-        PyBytes_FromStringAndSize(reinterpret_cast<const char*>(data),
-                                  static_cast<Py_ssize_t>(size * sizeof(mx_float))),
-        "bytes");
-    Obj np = Runtime::Get().np();
-    Obj flat = np.attr("frombuffer")(bytes, mxtpu::to_py("float32"));
-    return flat.attr("reshape")(shape.py_tuple());
-  }
   static Obj from_buffer(const mx_float* data, size_t size, const Shape& shape,
                          const Context& ctx) {
-    Obj arr = np_from_buffer(data, size, shape);
+    Obj arr = np_array_from_buffer(data, size, shape);
     Obj kw = KW()("ctx", ctx.py()).obj();
     Obj t = Obj::Steal(PyTuple_New(1), "tuple");
     PyTuple_SetItem(t.get(), 0, to_py(arr).release());
@@ -1058,6 +1066,82 @@ class Normal : public Initializer {
 class Zero : public Initializer {
  public:
   Zero() : Initializer(init_mod().attr("Zero")()) {}
+};
+
+// ---------------------------------------------------------------------------
+// Predictor — standalone inference (reference: include/mxnet/
+// c_predict_api.h MXPredCreate/SetInput/Forward/GetOutput and the
+// amalgamation packaging; here over mxnet_tpu.predict.Predictor /
+// load_bundle, the single-file deployment analog)
+// ---------------------------------------------------------------------------
+class Predictor {
+ public:
+  // MXPredCreate: symbol JSON + serialized params (mx.nd.save bytes).
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const std::map<std::string, Shape>& input_shapes,
+            const Context& ctx = Context::cpu()) {
+    Obj shapes = shape_dict(input_shapes);
+    Obj params = Obj::Steal(
+        PyBytes_FromStringAndSize(param_bytes.data(),
+                                  static_cast<Py_ssize_t>(param_bytes.size())),
+        "bytes");
+    h_ = mod().attr("Predictor")(to_py(symbol_json), params, shapes,
+                                 ctx.py());
+  }
+
+  // Load an export_bundle file (the amalgamation single-file analog).
+  static Predictor FromBundle(
+      const std::string& path,
+      const std::map<std::string, Shape>& input_shapes,
+      const Context& ctx = Context::cpu()) {
+    return Predictor(mod().attr("load_bundle")(
+        to_py(path), shape_dict(input_shapes), ctx.py()));
+  }
+
+  void SetInput(const std::string& name, const mx_float* data,
+                const Shape& shape) {
+    // hand python a host numpy array directly: routing through a device
+    // NDArray would round-trip host->device->host->device because
+    // set_input stages via np.asarray
+    h_.attr("set_input")(to_py(name),
+                         np_array_from_buffer(data, shape.Size(), shape));
+  }
+  void SetInput(const std::string& name, const std::vector<mx_float>& data,
+                const Shape& shape) {
+    SetInput(name, data.data(), shape);
+  }
+
+  void Forward() { h_.attr("forward")(); }
+
+  std::vector<mx_float> GetOutput(int index = 0) {
+    return bytes_to_vector(h_.attr("get_output")(to_py(index)));
+  }
+
+  Shape GetOutputShape(int index = 0) {
+    Obj out = h_.attr("get_output")(to_py(index));
+    return Shape(out.attr("shape"));
+  }
+
+  // MXPredReshape: rebind on new input shapes keeping weights.
+  void Reshape(const std::map<std::string, Shape>& input_shapes) {
+    h_.attr("reshape")(shape_dict(input_shapes));
+  }
+
+ private:
+  explicit Predictor(Obj h) : h_(std::move(h)) {}
+  static Obj mod() {
+    return Obj::Steal(PyImport_ImportModule("mxnet_tpu.predict"),
+                      "import mxnet_tpu.predict");
+  }
+  static Obj shape_dict(const std::map<std::string, Shape>& shapes) {
+    Obj d = Obj::Steal(PyDict_New(), "dict");
+    for (const auto& kv : shapes)
+      PyDict_SetItemString(d.get(), kv.first.c_str(),
+                           kv.second.py_tuple().get());
+    return d;
+  }
+
+  Obj h_;
 };
 
 }  // namespace mxtpu

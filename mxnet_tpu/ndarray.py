@@ -217,6 +217,24 @@ class NDArray:
     def asnumpy(self):
         return np.asarray(self._data)
 
+    def __array__(self, dtype=None, copy=None):
+        """numpy protocol: without this, np.asarray(nd) falls back to the
+        sequence protocol and builds the array ELEMENT-WISE through
+        __getitem__ — ~20k traced gathers for a (300, 64) input (found
+        via the C++ Predictor, which fed an NDArray to set_input's
+        np.asarray and appeared to hang). The numpy-2 ``copy`` contract
+        is honored: copy=True always copies, copy=False raises when a
+        copy cannot be avoided (device fetch / dtype change)."""
+        a = np.asarray(self._data)
+        if dtype is not None and a.dtype != np.dtype(dtype):
+            if copy is False:
+                raise ValueError(
+                    "NDArray.__array__: dtype conversion requires a copy")
+            return a.astype(dtype, copy=True)
+        if copy:
+            return a.copy()
+        return a
+
     def asscalar(self):
         if self.size != 1:
             raise MXNetError("The current array is not a scalar")

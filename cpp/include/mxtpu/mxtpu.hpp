@@ -325,16 +325,35 @@ inline Obj np_array_from_buffer(const mx_float* data, size_t size,
   return flat.attr("reshape")(shape.py_tuple());
 }
 
-// Extract a float32 copy of any array-like (NDArray or numpy) python
-// object into a C++ vector.
-inline std::vector<mx_float> bytes_to_vector(const Obj& array_like) {
+// array-like (NDArray.asnumpy() result or any numpy array) -> float32
+// PyBytes, exposing the raw buffer. Keeps the bytes object alive via the
+// returned Obj.
+inline Obj as_f32_bytes(const Obj& array_like, char** src, Py_ssize_t* n) {
   Obj b = array_like.attr("astype")(to_py("float32")).attr("tobytes")();
+  if (PyBytes_AsStringAndSize(b.get(), src, n) != 0)
+    ThrowPythonError("tobytes");
+  return b;
+}
+
+// Copy up to `size` float32 elements into `dest` (one memcpy straight
+// out of the bytes object); returns the element count available.
+inline size_t bytes_into_buffer(const Obj& array_like, mx_float* dest,
+                                size_t size) {
   char* src = nullptr;
   Py_ssize_t n = 0;
-  if (PyBytes_AsStringAndSize(b.get(), &src, &n) != 0)
-    ThrowPythonError("tobytes");
+  Obj keep = as_f32_bytes(array_like, &src, &n);
+  size_t avail = static_cast<size_t>(n) / sizeof(mx_float);
+  std::memcpy(dest, src, (avail < size ? avail : size) * sizeof(mx_float));
+  return avail;
+}
+
+// Extract a full float32 copy into a C++ vector (single conversion).
+inline std::vector<mx_float> bytes_to_vector(const Obj& array_like) {
+  char* src = nullptr;
+  Py_ssize_t n = 0;
+  Obj keep = as_f32_bytes(array_like, &src, &n);
   std::vector<mx_float> v(static_cast<size_t>(n) / sizeof(mx_float));
-  std::memcpy(v.data(), src, static_cast<size_t>(n));
+  std::memcpy(v.data(), src, v.size() * sizeof(mx_float));
   return v;
 }
 
@@ -402,10 +421,9 @@ class NDArray {
     SyncCopyFromCPU(data.data(), data.size());
   }
   void SyncCopyToCPU(mx_float* data, size_t size) const {
-    std::vector<mx_float> v = bytes_to_vector(h_.attr("asnumpy")());
-    if (v.size() < size)
+    size_t avail = bytes_into_buffer(h_.attr("asnumpy")(), data, size);
+    if (avail < size)
       throw std::runtime_error("SyncCopyToCPU: array smaller than request");
-    std::memcpy(data, v.data(), size * sizeof(mx_float));
   }
   std::vector<mx_float> AsVector() const {
     return bytes_to_vector(h_.attr("asnumpy")());

@@ -115,6 +115,7 @@ class Module(BaseModule):
         self._fused_batch = None
         self._fused_outputs = None
         self._fused_outs_raw = None
+        self._monitor = None
         self._fused_t = 0
         self._fused_exec_stale = False
 
@@ -148,6 +149,14 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        # a rebind invalidates the compiled fused trainer (shapes/mesh may
+        # change, and a monitor installed on the new bind needs the per-op
+        # executor path); optimizer + its state survive, reference-style
+        self._fused_trainer = None
+        self._fused_owner = None
+        self._fused_batch = None
+        self._fused_outputs = None
+        self._fused_outs_raw = None
 
     @property
     def data_names(self):
@@ -351,7 +360,9 @@ class Module(BaseModule):
                 "Module was given a mesh but training cannot take the "
                 "fused path: requires kvstore 'device'/'dist_device_sync' "
                 "(got %r), for_training, no inputs_need_grad, no "
-                "fixed_param_names, and batch_size %% dp == 0"
+                "fixed_param_names, no installed monitor (monitored "
+                "training needs the per-op executor path), and "
+                "batch_size %% dp == 0"
                 % (getattr(kvstore, "type", kvstore),))
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
@@ -383,6 +394,10 @@ class Module(BaseModule):
             and self.for_training
             and not self.inputs_need_grad
             and not self._fixed_param_names
+            # Monitor needs per-op executor callbacks; the fused
+            # whole-graph program has none, so monitored training keeps
+            # the reference's per-op executor path
+            and self._monitor is None
             and self._exec_group.batch_size % dp == 0
         )
 
@@ -689,4 +704,12 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        if self._fused_trainer is not None:
+            raise MXNetError(
+                "This module already trains through the fused whole-graph "
+                "XLA path, which has no per-op boundaries for Monitor "
+                "callbacks. Rebind first — fit(..., monitor=mon, "
+                "force_rebind=True) — so training routes through the "
+                "per-op executor path.")
+        self._monitor = mon
         self._exec_group.install_monitor(mon)

@@ -223,13 +223,15 @@ class NDArray:
         __getitem__ — ~20k traced gathers for a (300, 64) input (found
         via the C++ Predictor, which fed an NDArray to set_input's
         np.asarray and appeared to hang). The numpy-2 ``copy`` contract
-        is honored: copy=True always copies, copy=False raises when a
-        copy cannot be avoided (device fetch / dtype change)."""
+        is honored: copy=True always copies; copy=False always raises,
+        because materializing device-backed data can never be guaranteed
+        zero-copy."""
+        if copy is False:
+            raise ValueError(
+                "NDArray.__array__: cannot guarantee zero-copy for "
+                "device-backed data (np.asarray(nd, copy=False))")
         a = np.asarray(self._data)
         if dtype is not None and a.dtype != np.dtype(dtype):
-            if copy is False:
-                raise ValueError(
-                    "NDArray.__array__: dtype conversion requires a copy")
             return a.astype(dtype, copy=True)
         if copy:
             return a.copy()

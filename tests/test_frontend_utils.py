@@ -7,6 +7,7 @@ import logging
 from collections import namedtuple
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 
@@ -146,3 +147,47 @@ def test_print_summary_exact_param_counts(capsys):
     out = capsys.readouterr().out
     assert "conv1(Convolution)" in out
     assert "Total params: 5370" in out
+
+
+def test_monitor_with_module_fit_device_kvstore():
+    """Monitor must keep working when the kvstore would normally select
+    the fused whole-graph path: monitored training routes through the
+    per-op executor path (the fused program has no per-op boundaries)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc1"), name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)])
+    seen = []
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc1.*", sort=True)
+    orig_toc = mon.toc
+
+    def capture_toc():
+        rec = orig_toc()
+        seen.extend(rec)
+        return rec
+    mon.toc = capture_toc
+    mod.fit(it, num_epoch=1, optimizer="sgd", kvstore="device",
+            optimizer_params={"learning_rate": 0.1}, monitor=mon)
+    assert mod._fused_trainer is None  # executor path was used
+    assert any("fc1" in name for _s, name, _v in seen), seen[:5]
+
+
+def test_install_monitor_after_fused_init_errors():
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc1"), name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore="device", optimizer="sgd")
+    assert mod._fused_trainer is not None
+    with pytest.raises(mx.base.MXNetError):
+        mod.install_monitor(mx.monitor.Monitor(interval=1))

@@ -83,3 +83,16 @@ def test_bandwidth_measure_runs():
     results = measure.measure(sizes_mb=(0.25,), iters=2)
     assert results[0]["devices"] >= 1
     assert results[0]["busbw_GBps"] >= 0.0
+
+
+def test_op_docs_fresh():
+    """docs/op_docs.md must match the live registry (tools/gen_op_docs.py
+    --check is the CI freshness hook; SURVEY §5.6 docgen surface)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "gen_op_docs.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr

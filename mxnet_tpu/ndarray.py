@@ -753,3 +753,28 @@ def _init_random_module():
 
 _init_ndarray_module()
 _init_random_module()
+
+
+def imdecode(buf, index=0, flag=1, mean=None, clip_rect=None, out=None,
+             **kwargs):
+    """Decode an encoded image buffer to an HWC NDArray (parity: the
+    reference registers imdecode as an NDArray function,
+    src/io/image_io.cc — flag, mean subtraction, clip_rect crop, out).
+    Unknown options raise rather than silently change the result."""
+    if kwargs:
+        raise MXNetError("imdecode: unsupported option(s) %s"
+                         % sorted(kwargs))
+    from . import image as _image
+
+    img = _image.imdecode(buf, flag=flag)
+    if clip_rect is not None:
+        x0, y0, x1, y1 = (int(v) for v in clip_rect)
+        img = NDArray(img._data[y0:y1, x0:x1])
+    if mean is not None:
+        mean_arr = mean._data if isinstance(mean, NDArray) else np.asarray(
+            mean, np.float32)
+        img = NDArray(img._data.astype(np.float32) - mean_arr)
+    if out is not None:
+        out[:] = img
+        return out
+    return img

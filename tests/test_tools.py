@@ -88,9 +88,6 @@ def test_bandwidth_measure_runs():
 def test_op_docs_fresh():
     """docs/op_docs.md must match the live registry (tools/gen_op_docs.py
     --check is the CI freshness hook; SURVEY §5.6 docgen surface)."""
-    import subprocess
-    import sys
-
     r = subprocess.run(
         [sys.executable, os.path.join(TOOLS, "gen_op_docs.py"),
          "--check"],

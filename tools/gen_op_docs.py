@@ -25,27 +25,25 @@ def render():
 
     jax.config.update("jax_platforms", "cpu")
     from mxnet_tpu.ops import registry
-    import mxnet_tpu.contrib.ops  # noqa: F401  (registers contrib ops)
-    import mxnet_tpu.ops.rnn_op  # noqa: F401
-    import mxnet_tpu.ops.spatial  # noqa: F401
+    import mxnet_tpu.contrib.ops  # noqa: F401  (registers contrib ops;
+    # the core op modules load via mxnet_tpu.ops itself)
 
-    names = sorted(registry.list_ops())
+    names = [n for n in sorted(registry.list_ops())
+             if getattr(registry.get(n), "visible", True)]
     lines = [
         "# Operator reference (generated)",
         "",
-        "One entry per registered operator — regenerate with",
+        "One entry per visible registered operator — regenerate with",
         "`python tools/gen_op_docs.py` (CI checks freshness with",
         "`--check`). The same text backs each generated `mx.nd.<op>` /",
         "`mx.sym.<op>` docstring (reference analog:",
         "MXSymbolGetAtomicSymbolInfo's dmlc::Parameter docgen).",
         "",
-        "%d operators registered." % len(names),
+        "%d operators documented." % len(names),
         "",
     ]
     for name in names:
         op = registry.get(name)
-        if not getattr(op, "visible", True):
-            continue
         lines.append("## `%s`" % name)
         lines.append("")
         lines.append("```")

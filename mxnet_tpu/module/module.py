@@ -282,11 +282,27 @@ class Module(BaseModule):
             self.borrow_optimizer(shared_module)
 
     def reshape(self, data_shapes, label_shapes=None):
-        """Parity module.py:403."""
+        """Parity module.py:403. The reference's reshape re-binds
+        executors SHARING memory, so weights survive; here rebinding
+        allocates fresh executors, so the current weights must be carried
+        across explicitly (found by the GAN example: reshaping the
+        trained generator for a larger sample batch silently zeroed
+        it)."""
         assert self.binded
+        if (data_shapes == self._data_shapes
+                and label_shapes == self._label_shapes):
+            return  # no-op, like exec_group.reshape — skip the transfers
+        if self.params_initialized:
+            # device truth -> host unconditionally: when this module was
+            # bound with shared_module=, the TRAINED values live in the
+            # shared device arrays while our host dict may be a stale
+            # init snapshot and our own _params_dirty never flipped
+            self._sync_params_from_devices()
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
         self._exec_group.reshape(self._data_shapes, self._label_shapes)
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

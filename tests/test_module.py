@@ -160,3 +160,30 @@ def test_fixed_params():
     mod.update()
     w_after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
     np.testing.assert_array_equal(w_before, w_after)
+
+
+def test_reshape_preserves_trained_params():
+    """Module.reshape must carry CURRENT weights into the re-bound
+    executors (reference reshape shares executor memory; a fresh bind
+    that silently zeroes trained params was found via the GAN example)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+
+    batch = mx.io.DataBatch(data=[mx.nd.array(X[:16])], label=[])
+    mod.forward(batch, is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+
+    mod.reshape(data_shapes=[("data", (8, 8))],
+                label_shapes=[("softmax_label", (8,))])
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(X[:8])], label=[]),
+                is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, ref[:8], rtol=1e-5, atol=1e-6)

@@ -97,3 +97,22 @@ def test_rank_and_type():
 def test_get_num_dead_node():
     kv = mx.kvstore.create("dist_sync")
     assert kv.get_num_dead_node(0) == 0
+
+
+def test_all_accepted_types_route():
+    """Every reference kvstore type string creates a working store with
+    single-process semantics (dist_* fall back to size-1 local when no
+    launcher env is present); unknown types raise."""
+    for t in ("local", "local_allreduce_cpu", "local_allreduce_device",
+              "device", "dist_sync", "dist_device_sync", "dist_async"):
+        kv = mx.kvstore.create(t)
+        kv.init(7, mx.nd.ones((3,)))
+        out = mx.nd.zeros((3,))
+        kv.push(7, [mx.nd.ones((3,)) * 2, mx.nd.ones((3,))])
+        kv.pull(7, out=out)
+        # no updater: the reduced sum (2 + 1) REPLACES the stored value
+        np.testing.assert_allclose(out.asnumpy(), 3.0 * np.ones(3))
+        assert kv.type == t
+        assert kv.num_workers == 1  # no launcher env: size-1 fallback
+    with pytest.raises(mx.base.MXNetError):
+        mx.kvstore.create("definitely_not_a_store")

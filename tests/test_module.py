@@ -187,3 +187,34 @@ def test_reshape_preserves_trained_params():
                 is_train=False)
     got = mod.get_outputs()[0].asnumpy()
     np.testing.assert_allclose(got, ref[:8], rtol=1e-5, atol=1e-6)
+
+
+def test_feedforward_trainer_end_to_end(tmp_path):
+    """FeedForward (deprecated reference trainer, model.py) — the API the
+    reference's own train-tier tests use: fit on numpy, predict, score,
+    save/load round trip."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 10).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+
+    model = mx.model.FeedForward(
+        net, ctx=mx.cpu(), num_epoch=6, optimizer="sgd",
+        learning_rate=0.3, numpy_batch_size=32)
+    model.fit(X, y)
+
+    probs = model.predict(X)
+    assert probs.shape == (128, 2)
+    acc = ((probs[:, 1] > probs[:, 0]).astype(np.float32) == y).mean()
+    assert acc > 0.9, acc
+    score = model.score(mx.io.NDArrayIter(X, y, batch_size=32))
+    assert score[0] > 0.9, score
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 6)
+    loaded = mx.model.FeedForward.load(prefix, 6, ctx=mx.cpu(),
+                                       numpy_batch_size=32)
+    probs2 = loaded.predict(X)
+    np.testing.assert_allclose(probs2, probs, rtol=1e-5, atol=1e-6)

@@ -90,6 +90,21 @@ def get_lib():
         lib.csv_parse_floats.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64
         ]
+        # image decode (runtime-dlopened libjpeg; -1 = unavailable). A
+        # PREBUILT libmxtpu.so from before this symbol existed must keep
+        # its engine/recordio paths working (graceful-degradation
+        # contract above), so the absence of the symbol is non-fatal.
+        try:
+            lib.imdecode_jpeg.restype = ctypes.c_longlong
+            lib.imdecode_jpeg.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
+        except AttributeError:
+            pass
         _LIB = lib
         return _LIB
 
@@ -227,3 +242,34 @@ def csv_read_floats(path, expected):
     if n < 0:
         raise IOError("cannot parse %s" % path)
     return buf[:n]
+
+
+def imdecode_jpeg(buf, gray=False):
+    """Native JPEG decode to an HWC uint8 numpy array, or None when the
+    buffer isn't a decodable JPEG / libjpeg isn't on this host. ctypes
+    releases the GIL for the call, so the decode pool's worker threads
+    run truly in parallel (reference: the OpenMP decode team,
+    iter_image_recordio_2.cc:103)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "imdecode_jpeg"):
+        return None
+    data = bytes(buf)
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    need = lib.imdecode_jpeg(data, len(data), None, 0, int(gray),
+                             ctypes.byref(w), ctypes.byref(h),
+                             ctypes.byref(c))
+    if need < 0:
+        return None
+    out = np.empty(int(need), np.uint8)
+    got = lib.imdecode_jpeg(
+        data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        int(need), int(gray), ctypes.byref(w), ctypes.byref(h),
+        ctypes.byref(c))
+    if got != need:
+        return None
+    shape = (h.value, w.value) if c.value == 1 else (h.value, w.value, c.value)
+    return out.reshape(shape)

@@ -227,6 +227,16 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
 def _imdecode_np(buf, iscolor=-1):
     import io as _io
 
+    # native fast path: libjpeg through the GIL-releasing C library
+    # (parallel decode across pool threads); non-JPEG payloads and
+    # jpeg-less hosts fall through to PIL/cv2
+    if len(buf) >= 2 and buf[0] == 0xFF and buf[1] == 0xD8:
+        from . import native as _native
+
+        img = _native.imdecode_jpeg(buf, gray=(iscolor == 0))
+        if img is not None:
+            return img
+
     try:
         from PIL import Image
     except ImportError:

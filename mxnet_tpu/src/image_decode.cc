@@ -1,0 +1,152 @@
+// Native JPEG decode for the data pipeline (SURVEY.md §2.1 N11: the
+// reference decodes with an OpenCV/libjpeg OpenMP team,
+// iter_image_recordio_2.cc — this is the TPU build's equivalent fast
+// path; the python decode pool calls it through ctypes, which releases
+// the GIL, so worker threads decode truly in parallel where PIL would
+// serialize).
+//
+// libjpeg is resolved at RUNTIME via dlopen: the shared library builds
+// and loads everywhere, and hosts without libjpeg simply fall back to
+// the PIL path (imdecode_jpeg returns -1).
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__has_include)
+#if __has_include(<jpeglib.h>) && __has_include(<dlfcn.h>)
+#define MXTPU_HAVE_JPEG 1
+#endif
+#endif
+
+#ifdef MXTPU_HAVE_JPEG
+#include <dlfcn.h>
+#include <cstdio>  // jpeglib.h needs FILE
+#include <jpeglib.h>
+
+namespace {
+
+struct JpegApi {
+  struct jpeg_error_mgr* (*std_error)(struct jpeg_error_mgr*);
+  void (*create_decompress)(j_decompress_ptr, int, size_t);
+  void (*mem_src)(j_decompress_ptr, const unsigned char*, unsigned long);
+  int (*read_header)(j_decompress_ptr, boolean);
+  boolean (*start_decompress)(j_decompress_ptr);
+  JDIMENSION (*read_scanlines)(j_decompress_ptr, JSAMPARRAY, JDIMENSION);
+  boolean (*finish_decompress)(j_decompress_ptr);
+  void (*destroy_decompress)(j_decompress_ptr);
+  bool ok = false;
+};
+
+JpegApi load_api() {
+  JpegApi api;
+  const char* candidates[] = {"libjpeg.so.62", "libjpeg.so.8",
+                              "libjpeg.so.9", "libjpeg.so"};
+  void* h = nullptr;
+  for (const char* name : candidates) {
+    // RTLD_LOCAL: all symbols are fetched via dlsym, and exporting the
+    // system libjpeg globally could interpose onto the DIFFERENT libjpeg
+    // build PIL/cv2 bundle for the fallback path (ABI mismatch crash)
+    h = dlopen(name, RTLD_NOW | RTLD_LOCAL);
+    if (h != nullptr) break;
+  }
+  if (h == nullptr) return api;
+  auto sym = [h](const char* n) { return dlsym(h, n); };
+  api.std_error = reinterpret_cast<decltype(api.std_error)>(
+      sym("jpeg_std_error"));
+  api.create_decompress = reinterpret_cast<decltype(api.create_decompress)>(
+      sym("jpeg_CreateDecompress"));
+  api.mem_src = reinterpret_cast<decltype(api.mem_src)>(sym("jpeg_mem_src"));
+  api.read_header = reinterpret_cast<decltype(api.read_header)>(
+      sym("jpeg_read_header"));
+  api.start_decompress = reinterpret_cast<decltype(api.start_decompress)>(
+      sym("jpeg_start_decompress"));
+  api.read_scanlines = reinterpret_cast<decltype(api.read_scanlines)>(
+      sym("jpeg_read_scanlines"));
+  api.finish_decompress = reinterpret_cast<decltype(api.finish_decompress)>(
+      sym("jpeg_finish_decompress"));
+  api.destroy_decompress = reinterpret_cast<decltype(api.destroy_decompress)>(
+      sym("jpeg_destroy_decompress"));
+  api.ok = api.std_error && api.create_decompress && api.mem_src &&
+           api.read_header && api.start_decompress && api.read_scanlines &&
+           api.finish_decompress && api.destroy_decompress;
+  return api;
+}
+
+const JpegApi& api() {
+  static JpegApi a = load_api();
+  return a;
+}
+
+struct ErrorTrap {
+  struct jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void on_error(j_common_ptr cinfo) {
+  ErrorTrap* trap = reinterpret_cast<ErrorTrap*>(cinfo->err);
+  longjmp(trap->jump, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a JPEG buffer to tightly-packed RGB8 (gray=1 -> single
+// channel). Returns the byte size written (or required, when out is
+// null/too small) or -1 when the buffer is not decodable / libjpeg is
+// unavailable. w/h/c receive the image dims.
+long long imdecode_jpeg(const unsigned char* buf, long long len,
+                        unsigned char* out, long long cap, int gray,
+                        int* w, int* h, int* c) {
+  const JpegApi& J = api();
+  if (!J.ok || buf == nullptr || len < 4) return -1;
+  struct jpeg_decompress_struct cinfo;
+  ErrorTrap trap;
+  cinfo.err = J.std_error(&trap.mgr);
+  trap.mgr.error_exit = on_error;
+  if (setjmp(trap.jump)) {
+    J.destroy_decompress(&cinfo);
+    return -1;
+  }
+  J.create_decompress(&cinfo, JPEG_LIB_VERSION,
+                      sizeof(struct jpeg_decompress_struct));
+  J.mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  if (J.read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    J.destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
+  J.start_decompress(&cinfo);
+  const int width = static_cast<int>(cinfo.output_width);
+  const int height = static_cast<int>(cinfo.output_height);
+  const int channels = cinfo.output_components;
+  const long long need =
+      static_cast<long long>(width) * height * channels;
+  if (w != nullptr) *w = width;
+  if (h != nullptr) *h = height;
+  if (c != nullptr) *c = channels;
+  if (out == nullptr || cap < need) {
+    J.destroy_decompress(&cinfo);
+    return need;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + static_cast<long long>(cinfo.output_scanline) *
+                             width * channels;
+    J.read_scanlines(&cinfo, &row, 1);
+  }
+  J.finish_decompress(&cinfo);
+  J.destroy_decompress(&cinfo);
+  return need;
+}
+
+}  // extern "C"
+
+#else  // !MXTPU_HAVE_JPEG
+
+extern "C" long long imdecode_jpeg(const unsigned char*, long long,
+                                   unsigned char*, long long, int, int*,
+                                   int*, int*) {
+  return -1;
+}
+
+#endif

@@ -143,10 +143,13 @@ def test_watchdog_startup_deadline(tmp_path):
         open(flag, "w").close()
         time.sleep(600)          # never heartbeats
     """))
+    # startup_timeout must outlast interpreter boot on a LOADED CI box
+    # (2s flaked when a parallel suite pegged the cores), and a spare
+    # restart absorbs one spurious deadline kill
     rc = watchdog.supervise(
         [sys.executable, str(script), flag],
-        max_restarts=1, num_workers=1, heartbeat_timeout=60.0,
-        poll_interval=0.3, startup_timeout=2.0,
+        max_restarts=2, num_workers=1, heartbeat_timeout=60.0,
+        poll_interval=0.3, startup_timeout=8.0,
         run_dir=str(tmp_path / "run"), log=lambda *_: None)
     assert rc == 0
 

@@ -37,40 +37,67 @@ struct JpegApi {
   bool ok = false;
 };
 
+bool bind_api(void* h, JpegApi* api) {
+  auto sym = [h](const char* n) { return dlsym(h, n); };
+  api->std_error = reinterpret_cast<decltype(api->std_error)>(
+      sym("jpeg_std_error"));
+  api->create_decompress = reinterpret_cast<decltype(api->create_decompress)>(
+      sym("jpeg_CreateDecompress"));
+  api->mem_src = reinterpret_cast<decltype(api->mem_src)>(
+      sym("jpeg_mem_src"));
+  api->read_header = reinterpret_cast<decltype(api->read_header)>(
+      sym("jpeg_read_header"));
+  api->start_decompress = reinterpret_cast<decltype(api->start_decompress)>(
+      sym("jpeg_start_decompress"));
+  api->read_scanlines = reinterpret_cast<decltype(api->read_scanlines)>(
+      sym("jpeg_read_scanlines"));
+  api->finish_decompress = reinterpret_cast<decltype(api->finish_decompress)>(
+      sym("jpeg_finish_decompress"));
+  api->destroy_decompress =
+      reinterpret_cast<decltype(api->destroy_decompress)>(
+          sym("jpeg_destroy_decompress"));
+  return api->std_error && api->create_decompress && api->mem_src &&
+         api->read_header && api->start_decompress && api->read_scanlines &&
+         api->finish_decompress && api->destroy_decompress;
+}
+
 JpegApi load_api() {
   JpegApi api;
-  const char* candidates[] = {"libjpeg.so.62", "libjpeg.so.8",
-                              "libjpeg.so.9", "libjpeg.so"};
-  void* h = nullptr;
+  // Prefer the soname matching the COMPILED JPEG_LIB_VERSION: the
+  // runtime version/structsize check in jpeg_CreateDecompress rejects
+  // mismatched ABIs, so starting with the matching one avoids pinning a
+  // library we can't actually use.
+#if JPEG_LIB_VERSION >= 90
+  const char* candidates[] = {"libjpeg.so.9", "libjpeg.so",
+                              "libjpeg.so.8", "libjpeg.so.62"};
+#elif JPEG_LIB_VERSION >= 80
+  const char* candidates[] = {"libjpeg.so.8", "libjpeg.so",
+                              "libjpeg.so.9", "libjpeg.so.62"};
+#else
+  const char* candidates[] = {"libjpeg.so.62", "libjpeg.so",
+                              "libjpeg.so.8", "libjpeg.so.9"};
+#endif
   for (const char* name : candidates) {
     // RTLD_LOCAL: all symbols are fetched via dlsym, and exporting the
     // system libjpeg globally could interpose onto the DIFFERENT libjpeg
     // build PIL/cv2 bundle for the fallback path (ABI mismatch crash)
-    h = dlopen(name, RTLD_NOW | RTLD_LOCAL);
-    if (h != nullptr) break;
+    void* h = dlopen(name, RTLD_NOW | RTLD_LOCAL);
+    if (h == nullptr) continue;
+    if (bind_api(h, &api)) {
+      api.ok = true;
+      return api;
+    }
+    dlclose(h);  // unusable build (e.g. no jpeg_mem_src): try the next
   }
-  if (h == nullptr) return api;
-  auto sym = [h](const char* n) { return dlsym(h, n); };
-  api.std_error = reinterpret_cast<decltype(api.std_error)>(
-      sym("jpeg_std_error"));
-  api.create_decompress = reinterpret_cast<decltype(api.create_decompress)>(
-      sym("jpeg_CreateDecompress"));
-  api.mem_src = reinterpret_cast<decltype(api.mem_src)>(sym("jpeg_mem_src"));
-  api.read_header = reinterpret_cast<decltype(api.read_header)>(
-      sym("jpeg_read_header"));
-  api.start_decompress = reinterpret_cast<decltype(api.start_decompress)>(
-      sym("jpeg_start_decompress"));
-  api.read_scanlines = reinterpret_cast<decltype(api.read_scanlines)>(
-      sym("jpeg_read_scanlines"));
-  api.finish_decompress = reinterpret_cast<decltype(api.finish_decompress)>(
-      sym("jpeg_finish_decompress"));
-  api.destroy_decompress = reinterpret_cast<decltype(api.destroy_decompress)>(
-      sym("jpeg_destroy_decompress"));
-  api.ok = api.std_error && api.create_decompress && api.mem_src &&
-           api.read_header && api.start_decompress && api.read_scanlines &&
-           api.finish_decompress && api.destroy_decompress;
+  api.ok = false;
   return api;
 }
+
+void on_emit_message(j_common_ptr, int) {
+  // corrupt-but-decodable inputs would otherwise spam stderr from every
+  // decode-pool worker thread (the PIL path this replaces is silent)
+}
+void on_output_message(j_common_ptr) {}
 
 const JpegApi& api() {
   static JpegApi a = load_api();
@@ -104,6 +131,8 @@ long long imdecode_jpeg(const unsigned char* buf, long long len,
   ErrorTrap trap;
   cinfo.err = J.std_error(&trap.mgr);
   trap.mgr.error_exit = on_error;
+  trap.mgr.emit_message = on_emit_message;
+  trap.mgr.output_message = on_output_message;
   if (setjmp(trap.jump)) {
     J.destroy_decompress(&cinfo);
     return -1;

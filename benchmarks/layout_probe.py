@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Conv layout probe: NCHW (reference layout) vs NHWC end-to-end.
+
+The framework keeps the reference's NCHW/OIHW layouts at the API level
+and lets XLA assign physical layouts. This probe measures whether an
+NHWC-native lowering would buy anything on the current backend: it
+times one ResNet bottleneck stage (fwd+bwd) built both ways in raw JAX,
+same math, same dtype. If NHWC wins materially on TPU, the op library
+can add an internal layout rewrite (transpose at graph edges only);
+if not, the simple design stands with evidence.
+
+Run on TPU: python benchmarks/layout_probe.py
+Output: one JSON line per (layout, dtype).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def stage_params(rng, cin, cmid, layout, dtype):
+    import jax.numpy as jnp
+
+    def conv_w(ci, co, k):
+        w = rng.randn(co, ci, k, k).astype(np.float32) / np.sqrt(ci * k * k)
+        if layout == "NHWC":
+            w = w.transpose(2, 3, 1, 0)  # HWIO
+        return jnp.asarray(w, dtype)
+
+    return [conv_w(cin, cmid, 1), conv_w(cmid, cmid, 3),
+            conv_w(cmid, cin, 1)]
+
+
+def build_step(layout, dtype_name, batch, hw, cin, cmid, n_blocks):
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    if layout == "NCHW":
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 1, 1, 1), (1, 1, 1, 1), ("NCHW", "OIHW", "NCHW"))
+        x_shape = (batch, cin, hw, hw)
+    else:
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 1, 1, 1), (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC"))
+        x_shape = (batch, hw, hw, cin)
+
+    rng = np.random.RandomState(0)
+    params = []
+    for _ in range(n_blocks):
+        params.append(stage_params(rng, cin, cmid, layout, dtype))
+    x = jnp.asarray(rng.randn(*x_shape).astype(np.float32), dtype)
+
+    def conv(x, w, k):
+        pad = "SAME" if k == 3 else "VALID"
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), pad, dimension_numbers=dn)
+
+    def fwd(params, x):
+        for w1, w3, w2 in params:
+            h = jax.nn.relu(conv(x, w1, 1))
+            h = jax.nn.relu(conv(h, w3, 3))
+            x = x + conv(h, w2, 1)
+        return jnp.sum(x.astype(jnp.float32) ** 2)
+
+    grad = jax.jit(jax.grad(fwd))
+    return grad, params, x
+
+
+def measure(layout, dtype_name, batch=64, hw=28, cin=256, cmid=64,
+            n_blocks=8, iters=10):
+    import jax
+
+    grad, params, x = build_step(layout, dtype_name, batch, hw, cin, cmid,
+                                 n_blocks)
+    g = grad(params, x)
+    float(jax.tree_util.tree_leaves(g)[0].ravel()[0].astype("float32"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = grad(params, x)
+    float(jax.tree_util.tree_leaves(g)[0].ravel()[0].astype("float32"))
+    ms = 1000.0 * (time.perf_counter() - t0) / iters
+    return {"layout": layout, "dtype": dtype_name, "batch": batch,
+            "hw": hw, "cin": cin, "cmid": cmid, "blocks": n_blocks,
+            "fwdbwd_ms": round(ms, 3)}
+
+
+def main():
+    import jax
+
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device": str(jax.devices()[0])}), flush=True)
+    for dtype in ("bf16", "f32"):
+        rows = {}
+        for layout in ("NCHW", "NHWC"):
+            r = measure(layout, dtype)
+            rows[layout] = r["fwdbwd_ms"]
+            print(json.dumps(r), flush=True)
+        if rows["NHWC"] > 0:
+            print(json.dumps({
+                "dtype": dtype,
+                "nchw_over_nhwc": round(rows["NCHW"] / rows["NHWC"], 3),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -156,19 +156,57 @@ def stage(name):
     log("stage: %s" % name)
 
 
+def recorded_hardware_result():
+    """Most recent committed REAL-hardware measurement, for provenance
+    when the accelerator is unreachable at bench time (the remote tunnel
+    can wedge for hours independent of this framework). Clearly labeled:
+    never substituted for the primary value."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(
+        glob.glob(os.path.join(here, "benchmarks", "results",
+                               "bench_*.json")),
+        key=os.path.getmtime)  # newest LAST (lexicographic misorders r10 vs r3)
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except Exception:
+            continue
+        # only genuine accelerator measurements qualify as provenance
+        platform = str(data.get("platform", data.get("device", "")))
+        if "error" in data:
+            continue
+        if not ("tpu" in platform.lower() or "axon" in platform.lower()
+                or "TPU" in str(data.get("device_kind", ""))):
+            continue
+        data["_source"] = os.path.relpath(path, here)
+        return data
+    return None
+
+
 def emit(payload):
     print(json.dumps(payload), flush=True)
 
 
 def fail(exc):
-    emit({
+    out = {
         "metric": METRIC,
         "value": 0.0,
         "unit": "images/sec",
         "vs_baseline": 0.0 if BATCH == 32 else None,
         "error": "%s: %s" % (type(exc).__name__, str(exc)[:500]),
         "stage": _stage,
-    })
+    }
+    # provenance attaches ONLY for accelerator-unreachable failures — a
+    # crash during compile/measure on live hardware is a framework
+    # problem and must not arrive dressed as a tunnel outage
+    if _stage in ("start", "backend-init"):
+        rec = recorded_hardware_result()
+        if rec is not None:
+            out["recorded_tpu_result"] = rec
+    emit(out)
     traceback.print_exc(file=sys.stderr)
     sys.exit(0)
 
@@ -443,6 +481,13 @@ def main():
     out["vs_baseline"] = (
         round(img_s / BASELINE_IMG_S, 3) if BATCH == 32 else None
     )
+    if fell_back:
+        # CPU stand-in number: attach the most recent committed REAL
+        # hardware measurement with provenance (tunnel outages are
+        # environmental, not framework regressions)
+        rec = recorded_hardware_result()
+        if rec is not None:
+            out["recorded_tpu_result"] = rec
     if spec_peak:
         out["peak_tflops_spec"] = spec_peak
     if calib_tflops:

@@ -18,9 +18,6 @@ from .context import Context, cpu, current_context
 from .executor import Executor
 from .ndarray import NDArray
 
-_rng = np.random.RandomState(1234)
-
-
 def default_context():
     return current_context()
 
@@ -179,9 +176,14 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     """Finite-difference vs symbolic gradients on a random projection
     (parity test_utils.py:538)."""
     ctx = ctx or default_context()
+    # call-LOCAL rng: drawing from the module-global generator made the
+    # projection depend on how many other harness calls ran first — an
+    # order-dependent flake (a marginal log_softmax FD case flipped when
+    # a different suite ran earlier in the same process)
+    rng = np.random.RandomState(1234)
 
     def random_projection(shape):
-        plain = _rng.rand(*shape) + 0.1
+        plain = rng.rand(*shape) + 0.1
         return plain
 
     location = _parse_location(sym=sym, location=location, ctx=ctx)
@@ -212,7 +214,7 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     location = dict(location)
     location["__random_proj"] = nd.array(random_projection(out_shape[0]))
     args_grad_npy = {
-        k: _rng.normal(0, 0.01, size=location[k].shape) for k in grad_nodes
+        k: rng.normal(0, 0.01, size=location[k].shape) for k in grad_nodes
     }
     args_grad = {k: nd.array(v) for k, v in args_grad_npy.items()}
 
@@ -284,8 +286,10 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
     aux_states = _parse_aux_states(sym=sym, aux_states=aux_states, ctx=ctx)
     if isinstance(expected, (list, tuple)):
         expected = {k: v for k, v in zip(sym.list_arguments(), expected)}
+    # call-local for order-independence (see check_numeric_gradient)
+    _local_rng = np.random.RandomState(1234)
     args_grad_npy = {
-        k: _rng.normal(size=location[k].shape) for k in expected
+        k: _local_rng.normal(size=location[k].shape) for k in expected
     }
     args_grad_data = {k: nd.array(v) for k, v in args_grad_npy.items()}
     if isinstance(grad_req, str):
@@ -380,13 +384,15 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
         for name, arr in exe.aux_dict.items():
             arr[:] = aux_params[name]
 
-    dtypes = [np.dtype(exe.outputs[0].dtype) for exe in exe_list]
-    max_idx = np.argmax(dtypes)
-    gt = None
-
-    # forward
+    # forward (outputs are materialized lazily — dtype inspection must
+    # come AFTER the first run, not before; this harness predated the
+    # deferred-launch executor and broke silently, unexercised)
     for exe in exe_list:
         exe.forward(is_train=False)
+    dtypes = [np.dtype(exe.outputs[0].dtype) for exe in exe_list]
+    # ground truth = widest output dtype (argmax over np.dtype objects
+    # is not a defined ordering; itemsize is)
+    max_idx = int(np.argmax([dt.itemsize for dt in dtypes]))
     outputs = [[o.asnumpy() for o in exe.outputs] for exe in exe_list]
     gt = outputs[max_idx]
     for i, exe in enumerate(exe_list):
@@ -405,9 +411,20 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
 
     # train (forward+backward)
     if grad_req != "null":
-        for exe in exe_list:
+        for i, exe in enumerate(exe_list):
             exe.forward(is_train=True)
-            exe.backward([nd.array(o) for o in gt[: len(exe.outputs)]])
+            # head grads must live on the EXECUTOR's device and match
+            # ITS output dtype — the ground truth comes from the widest
+            # context (latent harness bugs: cpu(1) executors got cpu(0)
+            # cotangents, f64 executors got f32 ones; jit refuses both)
+            ctx_i = ctx_list[i]["ctx"]
+            exe.backward([
+                # explicit dtype: nd.array's reference-parity default
+                # silently downcasts f64 to f32
+                nd.array(np.asarray(g, dtype=mine.dtype), ctx=ctx_i,
+                         dtype=mine.dtype)
+                for g, mine in zip(gt[: len(exe.outputs)], outputs[i])
+            ])
         grads = [
             {k: v.asnumpy() for k, v in exe.grad_dict.items() if v is not None}
             for exe in exe_list

@@ -1,0 +1,64 @@
+"""mx.test_utils.check_consistency as a user-facing harness
+(reference: tests/python/gpu/test_operator_gpu.py drives the same
+helper across cpu/gpu/fp16 contexts). Here the axes are virtual CPU
+devices and dtype variants — identical inputs, cross-checked outputs
+AND gradients, through the public helper itself so ITS plumbing
+(type_dict casting, grad comparison, tolerance ladder) stays correct.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _conv_bn_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="conv")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=4,
+                                name="fc")
+    return net
+
+
+def test_consistency_across_devices():
+    """Same symbol, same inputs, two devices: bit-for-bit agreement of
+    outputs and gradients."""
+    sym = _conv_bn_sym()
+    ctx_list = [
+        {"ctx": mx.cpu(0), "data": (4, 3, 8, 8)},
+        {"ctx": mx.cpu(1), "data": (4, 3, 8, 8)},
+    ]
+    check_consistency(sym, ctx_list, tol=1e-6)
+
+
+def test_consistency_f32_vs_f64():
+    """Cross-dtype ladder (the reference's cpu-vs-fp16 axis): f64 run
+    agrees with f32 within f32 tolerance."""
+    sym = _conv_bn_sym()
+    shape = (4, 3, 8, 8)
+    ctx_list = [
+        {"ctx": mx.cpu(0), "data": shape,
+         "type_dict": {"data": np.float32}},
+        {"ctx": mx.cpu(1), "data": shape,
+         "type_dict": {"data": np.float64}},
+    ]
+    check_consistency(sym, ctx_list)
+
+
+def test_consistency_catches_divergence():
+    """The harness must FAIL when the programs genuinely differ —
+    different symbols on the two contexts (dropout-free vs scaled)."""
+    data = mx.sym.Variable("data")
+    a = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    b = mx.sym.FullyConnected(data * 2.0, num_hidden=4, name="fc")
+    ctx_list = [
+        {"ctx": mx.cpu(0), "data": (4, 6)},
+        {"ctx": mx.cpu(1), "data": (4, 6)},
+    ]
+    with pytest.raises(AssertionError):
+        check_consistency([a, b], ctx_list, tol=1e-6)

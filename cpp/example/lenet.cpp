@@ -1,0 +1,142 @@
+// lenet.cpp — conv net from the C++ frontend (reference analog:
+// cpp-package/example/lenet.cpp). Exercises Convolution/Pooling/
+// Flatten through the Operator builder and trains on the bundled
+// digits set reshaped to 8x8 images.
+//
+// Usage: lenet [--cpu]
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "mxtpu/mxtpu.hpp"
+
+using namespace mxtpu;  // NOLINT
+
+namespace {
+
+Symbol BuildLeNet() {
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("softmax_label");
+  Symbol c1 = Operator("Convolution")
+                  .SetParam("num_filter", 8)
+                  .SetParam("kernel", Shape{3, 3})
+                  .SetParam("pad", Shape{1, 1})(data)
+                  .CreateSymbol("c1");
+  Symbol a1 = Operator("Activation").SetParam("act_type", "tanh")(c1)
+                  .CreateSymbol("a1");
+  Symbol p1 = Operator("Pooling")
+                  .SetParam("kernel", Shape{2, 2})
+                  .SetParam("stride", Shape{2, 2})
+                  .SetParam("pool_type", "max")(a1)
+                  .CreateSymbol("p1");
+  Symbol c2 = Operator("Convolution")
+                  .SetParam("num_filter", 16)
+                  .SetParam("kernel", Shape{3, 3})
+                  .SetParam("pad", Shape{1, 1})(p1)
+                  .CreateSymbol("c2");
+  Symbol a2 = Operator("Activation").SetParam("act_type", "tanh")(c2)
+                  .CreateSymbol("a2");
+  Symbol p2 = Operator("Pooling")
+                  .SetParam("kernel", Shape{2, 2})
+                  .SetParam("stride", Shape{2, 2})
+                  .SetParam("pool_type", "max")(a2)
+                  .CreateSymbol("p2");
+  Symbol fl = Operator("Flatten")(p2).CreateSymbol("fl");
+  Symbol f1 = Operator("FullyConnected").SetParam("num_hidden", 64)(fl)
+                  .CreateSymbol("f1");
+  Symbol r1 = Operator("Activation").SetParam("act_type", "relu")(f1)
+                  .CreateSymbol("r1");
+  Symbol f2 = Operator("FullyConnected").SetParam("num_hidden", 10)(r1)
+                  .CreateSymbol("f2");
+  return Operator("SoftmaxOutput")
+      .SetInput("data", f2)
+      .SetInput("label", label)
+      .CreateSymbol("softmax");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::setvbuf(stdout, nullptr, _IOLBF, 0);
+  for (int i = 1; i < argc; ++i)
+    if (std::string(argv[i]) == "--cpu") Runtime::UsePlatform("cpu");
+
+  const size_t batch = 100, side = 8;
+  SeedEverything(7);  // deterministic init/shuffle: the 0.90 gate had a
+                      // ~4-sample margin under an unseeded RNG
+  Context ctx = Context::cpu();
+  Symbol net = BuildLeNet();
+
+  // digits as 1x8x8 images
+  Obj skl = Obj::Steal(PyImport_ImportModule("sklearn.datasets"),
+                       "import sklearn.datasets");
+  Obj ds = skl.attr("load_digits")();
+  std::vector<float> X = bytes_to_vector(
+      ds.attr("data").attr("__truediv__")(to_py(16.0)));
+  std::vector<float> y = bytes_to_vector(ds.attr("target"));
+  const size_t n = y.size(), train_n = 1500, val_n = n - train_n;
+
+  NDArray train_x(X.data(), train_n * side * side,
+                  Shape{train_n, 1, side, side}, ctx);
+  NDArray train_y(y.data(), train_n, Shape{train_n}, ctx);
+  NDArray val_x(X.data() + train_n * side * side, val_n * side * side,
+                Shape{val_n, 1, side, side}, ctx);
+  NDArray val_y(y.data() + train_n, val_n, Shape{val_n}, ctx);
+
+  std::map<std::string, NDArray> args_map = {
+      {"data", NDArray(Shape{batch, 1, side, side}, ctx)},
+      {"softmax_label", NDArray(Shape{batch}, ctx)},
+  };
+  Executor* exec = net.SimpleBind(ctx, args_map);
+  std::map<std::string, NDArray> val_args = {
+      {"data", NDArray(Shape{val_n, 1, side, side}, ctx)},
+      {"softmax_label", NDArray(Shape{val_n}, ctx)},
+  };
+  Executor* val_exec = net.SimpleBind(ctx, val_args, "null");
+
+  Xavier xavier("gaussian", "in", 2.0);
+  auto args = exec->arg_dict();
+  for (auto& kv : args) {
+    if (kv.first == "data" || kv.first == "softmax_label") continue;
+    xavier(kv.first, &kv.second);
+  }
+
+  Optimizer* opt = Optimizer::Find("sgd");
+  opt->SetParam("learning_rate", 0.15)
+      .SetParam("momentum", 0.9)
+      .SetParam("rescale_grad", 1.0 / batch);
+
+  NDArrayIter it(train_x, train_y, static_cast<int>(batch), true);
+  auto grads = exec->grad_dict();
+  for (int epoch = 0; epoch < 25; ++epoch) {
+    it.Reset();
+    while (it.Next()) {
+      it.GetData().CopyTo(&args["data"]);
+      it.GetLabel().CopyTo(&args["softmax_label"]);
+      exec->Forward(true);
+      exec->Backward();
+      int index = 0;
+      for (auto& kv : args) {
+        if (kv.first == "data" || kv.first == "softmax_label") {
+          ++index;
+          continue;
+        }
+        opt->Update(index++, kv.second, grads[kv.first]);
+      }
+    }
+  }
+
+  auto vargs = val_exec->arg_dict();
+  for (auto& kv : args)
+    if (kv.first != "data" && kv.first != "softmax_label")
+      kv.second.CopyTo(&vargs[kv.first]);
+  val_x.CopyTo(&vargs["data"]);
+  val_exec->Forward(false);
+  Accuracy acc;
+  acc.Update(val_y, val_exec->outputs[0]);
+  std::printf("lenet val-accuracy: %.4f\n", acc.Get());
+
+  delete exec;
+  delete val_exec;
+  return acc.Get() > 0.90f ? 0 : 1;
+}

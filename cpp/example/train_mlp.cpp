@@ -86,6 +86,7 @@ int main(int argc, char** argv) {
 
   const int batch = 100;
   const int epochs = 40;
+  SeedEverything(7);  // deterministic init/shuffle for the CI gates
   Context ctx = Context::cpu();
 
   Symbol net = BuildMLP();

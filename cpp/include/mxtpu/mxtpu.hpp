@@ -273,6 +273,17 @@ class Runtime {
   bool owns_interpreter_ = false;
 };
 
+// Seed numpy + the framework RNG (deterministic examples/CI; analog of
+// mx.random.seed in the python convergence gates).
+inline void SeedEverything(int seed) {
+  Runtime::Get();  // ensure the interpreter + mxnet_tpu are up
+  std::ostringstream code;
+  code << "import numpy as _np; _np.random.seed(" << seed << ")\n"
+       << "import mxnet_tpu as _mx; _mx.random.seed(" << seed << ")\n";
+  if (PyRun_SimpleString(code.str().c_str()) != 0)
+    ThrowPythonError("SeedEverything");
+}
+
 // ---------------------------------------------------------------------------
 // Shape (reference: cpp-package/include/mxnet-cpp/shape.h)
 // ---------------------------------------------------------------------------

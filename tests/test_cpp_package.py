@@ -1,42 +1,61 @@
-"""C++ frontend gate (SURVEY.md §2.1 N25, reference cpp-package/).
+"""C++ frontend gates (SURVEY.md §2.1 N25, reference cpp-package/).
 
-Compiles cpp/example/train_mlp.cpp against the embedded-CPython header
-(cpp/include/mxtpu/mxtpu.hpp) and runs it on the host platform: builds
-an MLP Symbol via Operator, SimpleBinds train/val Executors, trains
-with the sgd Optimizer, and round-trips a dmlc-format checkpoint.
-The binary itself enforces accuracy > 0.90 and an exact reload via its
-exit code (reference analog: Jenkinsfile example-smoke tier).
+Compiles the cpp/ examples against the embedded-CPython header and runs
+them on the host platform. The binaries enforce their own accuracy /
+roundtrip conditions via exit codes (reference analog: Jenkinsfile
+example-smoke tier).
 """
 import os
 import shutil
 import subprocess
+import sys
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CPP = os.path.join(REPO, "cpp")
 
+needs_toolchain = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("python3-config") is None,
+    reason="no C++ toolchain / python3-config")
 
-@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
-@pytest.mark.skipif(shutil.which("python3-config") is None,
-                    reason="no python3-config")
-def test_cpp_frontend_trains_and_roundtrips():
-    build = subprocess.run(
-        ["make", "-C", CPP], capture_output=True, text=True, timeout=300
-    )
-    assert build.returncode == 0, build.stdout + build.stderr
 
+def _build():
+    r = subprocess.run(["make", "-C", CPP], capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _run(binary):
     existing = os.environ.get("PYTHONPATH", "")
     env = dict(
         os.environ,
         PYTHONPATH=REPO + (os.pathsep + existing if existing else ""),
     )
-    run = subprocess.run(
-        [os.path.join(CPP, "build", "train_mlp"), "--cpu"],
+    return subprocess.run(
+        [os.path.join(CPP, "build", binary), "--cpu"],
         capture_output=True, text=True, timeout=900, env=env,
     )
+
+
+@needs_toolchain
+def test_cpp_frontend_trains_and_roundtrips():
+    _build()
+    run = _run("train_mlp")
     out = run.stdout
     assert run.returncode == 0, out + run.stderr
     assert "checkpoint-roundtrip: exact" in out, out
     final = [l for l in out.splitlines() if l.startswith("final-accuracy:")]
     assert final and float(final[0].split(":")[1]) > 0.90, out
+    assert "predictor-accuracy" in out, out
+
+
+@needs_toolchain
+def test_cpp_lenet_convnet_trains():
+    """Conv path through the C++ frontend (reference cpp-package ships
+    lenet.cpp): Convolution/Pooling/Flatten via Operator, >0.90 val
+    accuracy enforced by the binary's exit code."""
+    _build()
+    run = _run("lenet")
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "lenet val-accuracy" in run.stdout

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Decide HOW to ship the NHWC conv win: per-op transpose vs graph pass.
+
+layout_probe.py showed NHWC is ~2x NCHW for a bottleneck stage on v5e.
+The cheapest way to ship that inside the NCHW-API op library is a
+per-op internal rewrite: transpose the conv input NCHW->NHWC, run the
+conv with NHWC dimension numbers, transpose the result back. That only
+pays off if XLA cancels the back-to-back transposes BETWEEN layers
+(conv_out -> NCHW -> BN/relu -> NHWC -> conv_in), i.e. if elementwise
+and BN-style reduce ops let the transposes annihilate.
+
+Variants measured (same math, bf16 + f32, fwd+bwd):
+  nchw       pure NCHW conv chain with channel-dim BN+relu
+  nhwc       pure NHWC conv chain (upper bound)
+  wrapped    NCHW graph where every conv internally hops to NHWC
+
+If wrapped ~= nhwc, ship the per-op rewrite in ops/nn.py.
+If wrapped ~= nchw (or worse), a whole-graph layout pass is required.
+
+Run on TPU: python benchmarks/layout_wrap_probe.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(variant, dtype_name, batch, hw, cin, cmid, n_blocks):
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    nhwc = variant == "nhwc"
+    dn_nchw = jax.lax.conv_dimension_numbers(
+        (1, 1, 1, 1), (1, 1, 1, 1), ("NCHW", "OIHW", "NCHW"))
+    dn_nhwc = jax.lax.conv_dimension_numbers(
+        (1, 1, 1, 1), (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC"))
+
+    rng = np.random.RandomState(0)
+
+    def conv_w(ci, co, k):
+        w = rng.randn(co, ci, k, k).astype(np.float32) / np.sqrt(ci * k * k)
+        if nhwc:
+            w = w.transpose(2, 3, 1, 0)
+        return jnp.asarray(w, dtype)
+
+    params = []
+    for _ in range(n_blocks):
+        params.append(
+            [conv_w(cin, cmid, 1), conv_w(cmid, cmid, 3), conv_w(cmid, cin, 1),
+             jnp.ones((cmid,), dtype), jnp.zeros((cmid,), dtype),
+             jnp.ones((cmid,), dtype), jnp.zeros((cmid,), dtype)])
+    x_shape = (batch, hw, hw, cin) if nhwc else (batch, cin, hw, hw)
+    x = jnp.asarray(rng.randn(*x_shape).astype(np.float32), dtype)
+
+    def conv(x, w, k):
+        pad = "SAME" if k == 3 else "VALID"
+        if variant == "wrapped":
+            # the proposed op-library rewrite: NCHW in/out, NHWC inside
+            xi = jnp.transpose(x, (0, 2, 3, 1))
+            wi = jnp.transpose(w, (2, 3, 1, 0))
+            y = jax.lax.conv_general_dilated(
+                xi, wi, (1, 1), pad, dimension_numbers=dn_nhwc)
+            return jnp.transpose(y, (0, 3, 1, 2))
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), pad, dimension_numbers=dn_nchw if not nhwc
+            else dn_nhwc)
+
+    def bn(x, gamma, beta):
+        # batch-norm-shaped channel reduce in the API layout
+        axes = (0, 1, 2) if nhwc else (0, 2, 3)
+        shape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        mean = jnp.mean(x.astype(jnp.float32), axes, keepdims=True)
+        var = jnp.mean(
+            jnp.square(x.astype(jnp.float32)), axes, keepdims=True) - mean**2
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + 1e-5)
+        return (y * gamma.reshape(shape).astype(jnp.float32)
+                + beta.reshape(shape).astype(jnp.float32)).astype(x.dtype)
+
+    def fwd(params, x):
+        for w1, w3, w2, g1, b1, g3, b3 in params:
+            h = jax.nn.relu(bn(conv(x, w1, 1), g1, b1))
+            h = jax.nn.relu(bn(conv(h, w3, 3), g3, b3))
+            x = x + conv(h, w2, 1)
+        return jnp.sum(x.astype(jnp.float32) ** 2)
+
+    return jax.jit(jax.grad(fwd)), params, x
+
+
+def measure(variant, dtype_name, batch=64, hw=28, cin=256, cmid=64,
+            n_blocks=8, iters=10):
+    import jax
+
+    grad, params, x = build(variant, dtype_name, batch, hw, cin, cmid,
+                            n_blocks)
+    g = grad(params, x)
+    float(jax.tree_util.tree_leaves(g)[0].ravel()[0].astype("float32"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = grad(params, x)
+    float(jax.tree_util.tree_leaves(g)[0].ravel()[0].astype("float32"))
+    ms = 1000.0 * (time.perf_counter() - t0) / iters
+    return {"variant": variant, "dtype": dtype_name,
+            "fwdbwd_ms": round(ms, 3)}
+
+
+def main():
+    import jax
+
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device": str(jax.devices()[0])}), flush=True)
+    for dtype in ("bf16", "f32"):
+        rows = {}
+        for variant in ("nchw", "wrapped", "nhwc"):
+            r = measure(variant, dtype)
+            rows[variant] = r["fwdbwd_ms"]
+            print(json.dumps(r), flush=True)
+        print(json.dumps({
+            "dtype": dtype,
+            "nchw_over_wrapped": round(rows["nchw"] / rows["wrapped"], 3),
+            "wrapped_over_nhwc": round(rows["wrapped"] / rows["nhwc"], 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
+
+

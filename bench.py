@@ -294,8 +294,15 @@ def init_backend():
         INIT_RETRIES, INIT_TIMEOUT_S), True
 
 
-def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False):
+def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False, scan_k=0):
     """Train-step ResNet-50 at `batch`; return (img_s, step_ms, flops).
+
+    scan_k > 1 fuses K consecutive training steps into ONE dispatched
+    XLA program via lax.scan (carry = params/moms/aux). One dispatch
+    then pays the remote-tunnel latency once per K steps, so the
+    wall-clock rate converges on true device throughput instead of
+    estimating it by subtraction. `steps` counts dispatches in this
+    mode; reported step time is per inner step.
 
     bf16=True runs the reference's reduced-precision recipe
     (example/image-classification/symbols/resnet_fp16.py: fp16 compute,
@@ -356,7 +363,17 @@ def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False):
             new_moms[n] = m
         return new_params, new_moms, new_aux
 
-    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    if scan_k and scan_k > 1:
+        def k_steps(params, moms, aux, data, label):
+            def body(carry, _):
+                p, m, a = carry
+                return train_step(p, m, a, data, label), None
+            (p, m, a), _ = jax.lax.scan(
+                body, (params, moms, aux), None, length=scan_k)
+            return p, m, a
+        step = jax.jit(k_steps, donate_argnums=(0, 1, 2))
+    else:
+        step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     data = jnp.asarray(rng.rand(*data_shape), jnp.float32)
     label = jnp.asarray(rng.randint(0, 1000, batch), jnp.float32)
@@ -400,11 +417,18 @@ def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False):
     dt = time.perf_counter() - t0
 
     overhead_ms = None
-    try:
-        overhead_ms = measure_dispatch_overhead_ms(jax, jnp, params)
-    except Exception as e:
-        log("dispatch-overhead probe failed: %s" % e)
-    return batch * steps / dt, 1000.0 * dt / steps, flops_per_step, overhead_ms
+    if not (scan_k and scan_k > 1):  # scan row needs no tunnel correction
+        try:
+            overhead_ms = measure_dispatch_overhead_ms(jax, jnp, params)
+        except Exception as e:
+            log("dispatch-overhead probe failed: %s" % e)
+    n_inner = steps * (scan_k if scan_k and scan_k > 1 else 1)
+    if scan_k and scan_k > 1:
+        # cost_analysis may count the scan body once or K times depending
+        # on the XLA build; the caller supplies per-step flops from the
+        # equivalent non-scan row instead.
+        flops_per_step = None
+    return batch * n_inner / dt, 1000.0 * dt / n_inner, flops_per_step, overhead_ms
 
 
 def mfu_fields(prefix, step_ms, flops_per_step, peak_tflops):
@@ -459,11 +483,13 @@ def main():
                     % (calib_tflops, spec_peak, kind))
         except Exception as e:
             log("calibration failed: %s" % e)
-    # Denominator for MFU: the spec peak for the identified chip, unless
-    # the chip demonstrably sustains more (then the lookup was wrong and
-    # the measured number is the honest peak), or the kind is unknown.
+    # Denominator for MFU: the spec peak for the identified chip. The
+    # calibration only replaces it when the kind lookup failed, or when
+    # the chip sustains >1.5x spec (a mislabeled chip is off by 2-4x; a
+    # modest overshoot is two-point-slope timing noise — seen 231 vs the
+    # 197 spec on v5e — and must not deflate every MFU row).
     peak = spec_peak
-    if calib_tflops and (peak is None or calib_tflops > peak):
+    if calib_tflops and (peak is None or calib_tflops > 1.5 * peak):
         peak = calib_tflops
 
     stage("build")
@@ -498,7 +524,13 @@ def main():
         """Tunnel-corrected estimate: wall-clock rows stay primary; the
         measured fixed dispatch latency (an artifact of the remote test
         rig, not of the framework or chip) is subtracted for an
-        est_device_* view, clearly labeled as an estimate."""
+        est_device_* view, clearly labeled as an estimate.
+
+        Caveat established by the scan row: queued dispatches overlap
+        with device execution, so this subtraction OVERcorrects at
+        large step times. Where a scan row exists it supersedes the
+        est_device row (it measures, rather than estimates, the
+        device-only rate)."""
         if not overhead_ms or overhead_ms >= step_ms_row:
             return {}
         est = step_ms_row - overhead_ms
@@ -528,6 +560,7 @@ def main():
             out["batch%d_error" % BATCH2] = str(e)[:200]
         # bf16 mixed-precision row (reference fp16 recipe, TPU dtype):
         # this is the configuration the MXU is built for
+        flops3 = None
         try:
             img_s3, step_ms3, flops3, ovh3 = run_resnet50(
                 jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP, bf16=True)
@@ -540,6 +573,23 @@ def main():
         except Exception as e:
             log("bf16 run failed: %s" % e)
             out["bf16_error"] = str(e)[:200]
+        # K-step-scan row: one dispatch per K steps, so the wall-clock
+        # rate IS device throughput (no tunnel-latency subtraction).
+        scan_k = int(os.environ.get("BENCH_SCAN_K", "8"))
+        if scan_k > 1:
+            try:
+                img_s5, step_ms5, _, _ = run_resnet50(
+                    jax, jnp, BATCH2, 3, 1, bf16=True, scan_k=scan_k)
+                pre = "bf16_batch%d_scan%d_" % (BATCH2, scan_k)
+                out[pre + "images_per_sec"] = round(img_s5, 2)
+                out[pre + "step_ms"] = round(step_ms5, 2)
+                if flops3:
+                    m = mfu_fields(pre, step_ms5, flops3, peak)
+                    m.pop(pre + "tflops_per_step", None)
+                    out.update(m)
+            except Exception as e:
+                log("scan-%d run failed: %s" % (scan_k, e))
+                out["scan_error"] = str(e)[:200]
     emit(out)
 
 

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Dump the optimized HLO of the bench train step for fusion attribution.
+
+The device trace (profile_step.py) names kernels fusion.NNNN; this
+compiles the identical step and writes the optimized HLO module text so
+those names resolve to actual ops + shapes.
+
+Run: python benchmarks/hlo_dump.py /tmp/step_hlo.txt
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.profile_step import build_step  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/step_hlo.txt"
+    _, _, full_step, params, moms, aux, data, label = build_step(jax, jnp)
+    step = jax.jit(full_step, donate_argnums=(0, 1, 2))
+    lowered = step.lower(params, moms, aux, data, label)
+    compiled = lowered.compile()
+    try:
+        text = compiled.as_text()
+    except Exception:
+        text = "\n".join(m.to_string()
+                         for m in compiled.runtime_executable().hlo_modules())
+    with open(out_path, "w") as f:
+        f.write(text)
+    print("wrote", out_path, os.path.getsize(out_path), "bytes")
+
+
+if __name__ == "__main__":
+    main()

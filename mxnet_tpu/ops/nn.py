@@ -482,7 +482,80 @@ register(
 # BatchNorm — reference batch_norm-inl.h. aux: moving_mean/moving_var;
 # outputs (output, save_mean, save_var) with 1 visible. Per-replica stats
 # (no cross-replica sync) to match reference convergence (SURVEY.md §7).
+#
+# The training path is a custom_vjp core tuned from a v5e device trace:
+# autodiff through the two-pass stats formulation cost 27.5 ms of a
+# 110 ms ResNet-50 b256 step (25%). The core does one-pass stats
+# (sum / sum-of-squares in a single multi-output reduce over the bf16
+# input with f32 accumulation) and a closed-form backward (one fused
+# (sum(dy), sum(dy*xhat)) reduce + one dx pass), which is the minimum
+# HBM traffic without a persistent kernel.
 # --------------------------------------------------------------------------
+def _bn_reduce_axes(ndim):
+    return tuple(i for i in range(ndim) if i != 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bn_train_core(x, gamma, beta, eps):
+    y, mean, var, _rstd = _bn_train_fwd_math(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _bn_train_fwd_math(x, gamma, beta, eps):
+    ax = _bn_reduce_axes(x.ndim)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    n = x.size // x.shape[1]
+    x32 = x.astype(jnp.float32)
+    # two reduces over one operand: XLA fuses into a single pass
+    s1 = jnp.sum(x32, axis=ax)
+    s2 = jnp.sum(x32 * x32, axis=ax)
+    mean = s1 / n
+    # E[x^2] - mean^2; clamp tiny negative cancellation residue
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    scale = (gamma.astype(jnp.float32) * rstd).reshape(bshape)
+    shift = (beta.astype(jnp.float32)
+             - gamma.astype(jnp.float32) * rstd * mean).reshape(bshape)
+    y = (x32 * scale + shift).astype(x.dtype)
+    return y, mean, var, rstd
+
+
+def _bn_core_fwd(x, gamma, beta, eps):
+    y, mean, var, rstd = _bn_train_fwd_math(x, gamma, beta, eps)
+    return (y, mean, var), (x, gamma, mean, rstd)
+
+
+def _bn_core_bwd(eps, res, cts):
+    dy, dmean, dvar = cts
+    x, gamma, mean, rstd = res
+    ax = _bn_reduce_axes(x.ndim)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    n = x.size // x.shape[1]
+    g32 = gamma.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    xhat = (x32 - mean.reshape(bshape)) * rstd.reshape(bshape)
+    # one fused two-output reduce over (dy, x)
+    dbeta = jnp.sum(dy32, axis=ax)
+    dgamma = jnp.sum(dy32 * xhat, axis=ax)
+    # closed-form dx (plus the mean/var cotangent terms: mean/var are
+    # real graph outputs, so their cotangents must flow even though
+    # they are zero in the usual training step)
+    dx32 = (g32 * rstd).reshape(bshape) * (
+        dy32 - (dbeta / n).reshape(bshape) - xhat * (dgamma / n).reshape(bshape)
+    )
+    dx32 = dx32 + (dmean / n).reshape(bshape).astype(jnp.float32)
+    dx32 = dx32 + (
+        dvar.reshape(bshape).astype(jnp.float32)
+        * 2.0 / n * (x32 - mean.reshape(bshape))
+    )
+    return (dx32.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+_bn_train_core.defvjp(_bn_core_fwd, _bn_core_bwd)
+
+
 def _batch_norm(attrs, ins, is_train):
     data, gamma, beta, moving_mean, moving_var = ins
     eps = float(attrs.get("eps", 1e-3))
@@ -500,15 +573,7 @@ def _batch_norm(attrs, ins, is_train):
             var.reshape(bshape) + eps
         ) * gamma.reshape(bshape) + beta.reshape(bshape)
     else:
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=ax)
-        var = jnp.mean(jnp.square(x32 - mean.reshape(bshape)), axis=ax)
-        out = (
-            (x32 - mean.reshape(bshape))
-            * jax.lax.rsqrt(var.reshape(bshape) + eps)
-            * gamma.reshape(bshape).astype(jnp.float32)
-            + beta.reshape(bshape).astype(jnp.float32)
-        ).astype(data.dtype)
+        out, mean, var = _bn_train_core(data, gamma, beta, eps)
         new_mean = momentum * moving_mean + (1.0 - momentum) * mean.astype(
             moving_mean.dtype
         )

@@ -85,6 +85,25 @@ def test_bandwidth_measure_runs():
     assert results[0]["busbw_GBps"] >= 0.0
 
 
+def test_bandwidth_kvstore_mode():
+    """Reference-parity mode (tools/bandwidth/measure.py --network):
+    real per-layer model gradients through the product KVStore, merged
+    result must match the numpy oracle exactly (error == 0), both with
+    and without the optimizer applied on the store."""
+    sys.path.insert(0, os.path.join(TOOLS, "bandwidth"))
+    import measure
+
+    rows = measure.measure_kvstore(
+        network="mlp", ndev=3, kv_store="local", num_batches=2,
+        image_shape="1,28,28", num_classes=10)
+    assert len(rows) == 2
+    assert all(r["error"] == 0.0 for r in rows)
+    rows = measure.measure_kvstore(
+        network="mlp", ndev=2, kv_store="device", num_batches=2,
+        image_shape="1,28,28", num_classes=10, optimizer="sgd")
+    assert all(r["error"] == 0.0 for r in rows)
+
+
 def test_op_docs_fresh():
     """docs/op_docs.md must match the live registry (tools/gen_op_docs.py
     --check is the CI freshness hook; SURVEY §5.6 docgen surface)."""

@@ -117,6 +117,81 @@ def dump_profile():
 
 
 # jax passthroughs for device-side profiling
+def hlo_metadata_map(hlo_text):
+    """Instruction name -> (op_name, source_file, source_line) from an
+    optimized-HLO dump (``compiled.as_text()``).
+
+    XLA kernel names in a device trace (``fusion.1761``,
+    ``convolution_reduce_fusion`` ...) are meaningless on their own; the
+    HLO metadata carries the jax op and the framework source line each
+    fusion descends from. This map is the join key."""
+    import re
+
+    meta = {}
+    pat = re.compile(r'%([\w.\-]+) = [^\n]*?metadata=\{([^}]*)\}')
+    for m in pat.finditer(hlo_text):
+        name, blob = m.groups()
+        op = re.search(r'op_name="([^"]+)"', blob)
+        sf = re.search(r'source_file="([^"]+)"', blob)
+        sl = re.search(r'source_line=(\d+)', blob)
+        if op is None:
+            continue
+        meta.setdefault(name, (op.group(1),
+                               sf.group(1) if sf else "?",
+                               int(sl.group(1)) if sl else 0))
+    return meta
+
+
+def attribute_trace(trace_dir, hlo_text, top=30):
+    """Aggregate device-kernel time by framework source line.
+
+    trace_dir: a directory previously passed to jax.profiler.trace /
+    start_jax_trace. hlo_text: ``jit(f).lower(...).compile().as_text()``
+    of the program that ran inside the trace. Returns rows
+    ``{"ms", "op", "source"}`` sorted by total device time, descending —
+    the view that located the 25%-of-step BatchNorm cost this framework's
+    ResNet bench shed (see benchmarks/profile_step.py for the workflow).
+
+    Device lanes are preferred (pid named '/device:...'); if none exist
+    (cpu backend) any trace event whose name appears in the HLO is
+    counted instead."""
+    import glob
+    import gzip
+    import re
+
+    meta = hlo_metadata_map(hlo_text)
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not paths:
+        raise FileNotFoundError("no *.trace.json.gz under %r" % trace_dir)
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    device_pids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "/device:" in str(e.get("args", {}).get("name", ""))
+    }
+    umbrella = re.compile(r"^(jit_|\d+$)")  # whole-program + step markers
+    agg = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "")
+        if umbrella.match(name) or name not in meta:
+            continue
+        op, sf, sl = meta[name]
+        key = ("/".join(op.split("/")[-2:]),
+               "%s:%d" % (os.path.basename(sf), sl))
+        agg[key] = agg.get(key, 0.0) + e.get("dur", 0)
+    rows = [{"ms": us / 1000.0, "op": op, "source": src}
+            for (op, src), us in agg.items()]
+    rows.sort(key=lambda r: -r["ms"])
+    return rows[:top] if top else rows
+
+
 def start_jax_trace(log_dir):
     import jax
 

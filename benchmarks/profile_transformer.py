@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Profile one transformer-LM train step on the real chip.
+
+Same workflow as profile_step.py (which found the ResNet BN cost), on
+the flagship transformer (models/transformer.py): fwd / fwd+bwd / full
+AdamW-style step timings, then a jax.profiler device trace attributed
+to source lines via profiler.attribute_trace.
+
+Run on TPU:  python benchmarks/profile_transformer.py [outdir]
+Env: PROFILE_BATCH (def 8), PROFILE_SEQ (def 2048), PROFILE_LAYERS (12),
+     PROFILE_DMODEL (1024), PROFILE_ITERS (10)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = int(os.environ.get("PROFILE_BATCH", "8"))
+SEQ = int(os.environ.get("PROFILE_SEQ", "2048"))
+LAYERS = int(os.environ.get("PROFILE_LAYERS", "12"))
+DMODEL = int(os.environ.get("PROFILE_DMODEL", "1024"))
+ITERS = int(os.environ.get("PROFILE_ITERS", "10"))
+VOCAB = 32000
+
+
+def build(jax, jnp):
+    from mxnet_tpu.models.transformer import transformer_lm
+
+    init_fn, apply_fn = transformer_lm(
+        vocab=VOCAB, d_model=DMODEL, n_heads=DMODEL // 64, n_layers=LAYERS,
+        d_ff=4 * DMODEL)
+    params = jax.tree_util.tree_map(jnp.asarray, init_fn(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, VOCAB, (BATCH, SEQ)), jnp.int32)
+    targets = jnp.asarray(rng.randint(0, VOCAB, (BATCH, SEQ)), jnp.int32)
+
+    def loss_fn(p, tokens, targets):
+        logits = apply_fn(p, tokens)
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], -1)
+        return jnp.mean(nll)
+
+    def fwd(p, tokens, targets):
+        return loss_fn(p, tokens, targets)
+
+    def fwd_bwd(p, tokens, targets):
+        return jax.grad(loss_fn)(p, tokens, targets)
+
+    def full_step(p, m, v, tokens, targets, t):
+        g = jax.grad(loss_fn)(p, tokens, targets)
+        b1, b2, lr, eps = 0.9, 0.95, 3e-4, 1e-8
+        new_p, new_m, new_v = {}, {}, {}
+
+        def upd(p_, g_, m_, v_):
+            m2 = b1 * m_ + (1 - b1) * g_
+            v2 = b2 * v_ + (1 - b2) * g_ * g_
+            mh = m2 / (1 - b1 ** t)
+            vh = v2 / (1 - b2 ** t)
+            return p_ - lr * mh / (jnp.sqrt(vh) + eps), m2, v2
+
+        flat_p, tree = jax.tree_util.tree_flatten(p)
+        flat_g = jax.tree_util.tree_leaves(g)
+        flat_m = jax.tree_util.tree_leaves(m)
+        flat_v = jax.tree_util.tree_leaves(v)
+        out = [upd(a, b, c, d)
+               for a, b, c, d in zip(flat_p, flat_g, flat_m, flat_v)]
+        new_p = jax.tree_util.tree_unflatten(tree, [o[0] for o in out])
+        new_m = jax.tree_util.tree_unflatten(tree, [o[1] for o in out])
+        new_v = jax.tree_util.tree_unflatten(tree, [o[2] for o in out])
+        return new_p, new_m, new_v
+
+    m0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    v0 = jax.tree_util.tree_map(jnp.zeros_like, params)  # distinct buffers:
+    # m and v are both donated, and donating one buffer twice is an error
+    return fwd, fwd_bwd, full_step, params, m0, v0, tokens, targets
+
+
+def lm_flops_per_step():
+    # 6 * params_active * tokens (fwd+bwd), attention term included
+    p_layer = 12 * DMODEL * DMODEL
+    p_active = LAYERS * p_layer + VOCAB * DMODEL
+    toks = BATCH * SEQ
+    attn = 12 * LAYERS * BATCH * SEQ * SEQ * DMODEL  # qk^T + av, fwd+bwd
+    return 6 * p_active * toks + attn
+
+
+def timeit(jax, fn, args, tag):
+    out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(leaf).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(leaf).ravel()[0])
+    ms = 1000.0 * (time.perf_counter() - t0) / ITERS
+    print(json.dumps({"probe": tag, "ms": round(ms, 2)}), flush=True)
+    return ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import profiler
+
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/jax_trace_tfm"
+    print(json.dumps({
+        "backend": jax.default_backend(), "device": str(jax.devices()[0]),
+        "batch": BATCH, "seq": SEQ, "layers": LAYERS, "d_model": DMODEL,
+    }), flush=True)
+    fwd, fwd_bwd, full_step, params, m0, v0, tokens, targets = build(jax, jnp)
+
+    jf = jax.jit(fwd)
+    jfb = jax.jit(fwd_bwd)
+    jstep = jax.jit(full_step, donate_argnums=(0, 1, 2))
+    t = jnp.asarray(1.0, jnp.float32)
+
+    t_f = timeit(jax, jf, (params, tokens, targets), "fwd")
+    t_fb = timeit(jax, jfb, (params, tokens, targets), "fwd+bwd")
+    compiled = jstep.lower(params, m0, v0, tokens, targets, t).compile()
+    m, v = m0, v0
+    out = compiled(params, m, v, tokens, targets, t)
+    float(np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+    params, m, v = out
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, m, v = compiled(params, m, v, tokens, targets, t)
+    float(np.asarray(jax.tree_util.tree_leaves(params)[0]).ravel()[0])
+    t_full = 1000.0 * (time.perf_counter() - t0) / ITERS
+    flops = lm_flops_per_step()
+    print(json.dumps({
+        "probe": "full_step", "ms": round(t_full, 2),
+        "tflops_per_step": round(flops / 1e12, 3),
+        "achieved_tflops": round(flops / (t_full / 1e3) / 1e12, 1),
+    }), flush=True)
+
+    try:
+        with jax.profiler.trace(outdir):
+            for _ in range(3):
+                params, m, v = compiled(params, m, v, tokens, targets, t)
+            float(np.asarray(
+                jax.tree_util.tree_leaves(params)[0]).ravel()[0])
+        rows = profiler.attribute_trace(outdir, compiled.as_text(), top=20)
+        for r in rows:
+            print(json.dumps(r), flush=True)
+    except Exception as e:
+        print(json.dumps({"trace_error": repr(e)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

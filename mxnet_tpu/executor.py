@@ -545,13 +545,17 @@ class Executor:
                 f = jax.checkpoint(f, policy=_mirror_policy)
 
             diff_vals = tuple(args[n] for n in grad_names)
-            (outs, new_aux), vjp_fn = jax.vjp(f, diff_vals)
+            # has_aux: aux-state updates ride OUTSIDE the cotangent space
+            # (they never carry gradient), so ops whose bwd rule detects
+            # symbolic-zero cotangents (BatchNorm's mean/var outputs)
+            # skip those terms instead of streaming zero arrays through
+            # the graph.
+            outs, vjp_fn, new_aux = jax.vjp(f, diff_vals, has_aux=True)
             if out_grads is None:
                 cts = tuple(jnp.ones_like(o) for o in outs)
             else:
                 cts = tuple(out_grads)
-            zero_aux_ct = tuple(jnp.zeros_like(a) for a in new_aux)
-            (grads,) = vjp_fn((cts, zero_aux_ct))
+            (grads,) = vjp_fn(cts)
             return outs, new_aux, grads
 
         return fwdbwd

@@ -509,7 +509,10 @@ def _arm_stall_guard(out, stall_s):
             if rec is not None:
                 snap["recorded_tpu_result"] = rec
             emit(snap)
-            os._exit(0)
+            # Exit nonzero so harnesses keyed on exit status can tell a
+            # wedged run from a clean one (the JSON line is still the
+            # primary contract; partial_reason carries the detail).
+            os._exit(3)
 
     t = threading.Thread(target=guard, daemon=True)
     t.start()

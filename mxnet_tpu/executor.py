@@ -308,17 +308,21 @@ class _PlacedProgram:
                 if self._needs_ct.get(nid, False))
             run = self._seg_run(si, True)
 
-            def bwd(in_vals, rng, cts_out, aux_cts):
+            def bwd(in_vals, rng, cts_out):
                 diff_vals = tuple(in_vals[i] for i in diff_idx)
 
+                # has_aux keeps aux-state updates (BN running stats)
+                # outside the cotangent space, so custom_vjp symbolic-zero
+                # fast paths (e.g. BN's one-pass backward) apply on the
+                # placed path exactly as on the fused path.
                 def f(dv):
                     iv = list(in_vals)
                     for i, v in zip(diff_idx, dv):
                         iv[i] = v
                     return run(tuple(iv), rng)
 
-                _, vjp_fn = jax.vjp(f, diff_vals)
-                (cts_in,) = vjp_fn((cts_out, aux_cts))
+                _, vjp_fn, _aux = jax.vjp(f, diff_vals, has_aux=True)
+                (cts_in,) = vjp_fn(cts_out)
                 return cts_in
 
             self._fn_cache[key] = (jax.jit(bwd), diff_idx)
@@ -377,7 +381,7 @@ class _PlacedProgram:
         for si in reversed(range(len(self.segments))):
             dev, _nodes = self.segments[si]
             needs, out_keys, _aux_names = self._seg_io[si]
-            in_vals, aux_vals, rng = saved[si]
+            in_vals, _aux_vals, rng = saved[si]
             bwd, diff_idx = self._seg_bwd_fn(si)
             if not diff_idx:
                 continue  # nothing upstream of this segment needs grads
@@ -386,8 +390,7 @@ class _PlacedProgram:
                 else jnp.zeros_like(env[k])
                 for k in out_keys
             )
-            aux_cts = tuple(jnp.zeros_like(a) for a in aux_vals)
-            cts_in = bwd(in_vals, rng, cts_out, aux_cts)
+            cts_in = bwd(in_vals, rng, cts_out)
             for i, ct in zip(diff_idx, cts_in):
                 _accum(needs[i], ct)
         return ct_env

@@ -164,9 +164,23 @@ def attribute_trace(trace_dir, hlo_text, top=30):
         os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
     if not paths:
         raise FileNotFoundError("no *.trace.json.gz under %r" % trace_dir)
-    with gzip.open(paths[-1], "rt") as f:
-        trace = json.load(f)
-    events = trace.get("traceEvents", [])
+    # jax.profiler can split device and host planes (or multiple hosts)
+    # across several files in one run directory; aggregate every file
+    # that shares the newest run's directory, not just the newest file.
+    run_dir = os.path.dirname(paths[-1])
+    # Chrome-trace pids are a PER-FILE namespace: key both the events and
+    # the device-plane metadata by (file_index, pid) so one file's device
+    # pid can't admit another file's host plane (or vice versa).
+    events = []
+    fi = 0
+    for p in paths:
+        if os.path.dirname(p) != run_dir:
+            continue
+        with gzip.open(p, "rt") as f:
+            for e in json.load(f).get("traceEvents", []):
+                e["pid"] = (fi, e.get("pid"))
+                events.append(e)
+        fi += 1
     device_pids = {
         e["pid"] for e in events
         if e.get("ph") == "M" and e.get("name") == "process_name"

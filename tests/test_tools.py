@@ -97,11 +97,13 @@ def test_bandwidth_kvstore_mode():
         network="mlp", ndev=3, kv_store="local", num_batches=2,
         image_shape="1,28,28", num_classes=10)
     assert len(rows) == 2
-    assert all(r["error"] == 0.0 for r in rows)
+    # Tolerance (not exact zero): a pairwise/tree device reduction is a
+    # legitimate KVStore implementation and reorders the float sums.
+    assert all(r["error"] < 1e-6 for r in rows)
     rows = measure.measure_kvstore(
         network="mlp", ndev=2, kv_store="device", num_batches=2,
         image_shape="1,28,28", num_classes=10, optimizer="sgd")
-    assert all(r["error"] == 0.0 for r in rows)
+    assert all(r["error"] < 1e-6 for r in rows)
 
 
 def test_op_docs_fresh():

@@ -37,7 +37,14 @@ WARMUP = 3
 # ~560s, so the pre-fallback budget (retries * probe timeout) must leave
 # room for the CPU fallback's compile + shortened measurement.
 INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT", "120"))
-INIT_RETRIES = 2
+# Backoff schedule for the init probe: a healthy tunnel answers in well
+# under 30s, so the first short attempt detects it cheaply; the longer
+# attempts cover a slow-but-live claim queue. A genuinely wedged tunnel
+# consumes the whole schedule — the sum (plus the CPU fallback's ~150s)
+# must stay inside the harness kill window (~560s observed round 1).
+INIT_SCHEDULE = tuple(
+    int(s) for s in os.environ.get(
+        "BENCH_INIT_SCHEDULE", "30,120,210").split(","))
 METRIC = "resnet50_train_images_per_sec_batch%d" % BATCH
 
 # Spec-sheet bf16 peak TFLOP/s per chip, keyed by substrings of
@@ -230,21 +237,40 @@ def _probe_backend_subprocess(timeout_s):
     The axon plugin's client init is a blocking native call: a SIGALRM
     in-process would only be delivered after it returns (i.e. never when
     the tunnel is wedged). A subprocess with a hard timeout is the only
-    interruptible probe. Returns platform string or None."""
+    interruptible probe. Returns platform string or None.
+
+    Claim hygiene (round-4): a SIGKILLed client mid-claim is the
+    documented poison trigger for the tunnel (the claim never frees and
+    every later client wedges for hours). On timeout the probe child
+    gets SIGTERM + a grace period to detach cleanly; SIGKILL only as a
+    last resort, logged loudly so the wedge cause is attributable."""
     code = ("import jax\n"
             "d = jax.devices()\n"
             "print('PROBE_OK %d %s' % (len(d), d[0].platform), flush=True)\n")
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code], timeout=timeout_s,
-            capture_output=True, text=True,
-        )
+        stdout, stderr = p.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        p.terminate()  # SIGTERM: observed safe for a claim/fetch-blocked client
+        try:
+            # communicate (not wait): keeps draining the pipes so a
+            # teardown-chatty child can't block on a full pipe and eat
+            # the SIGKILL this grace period exists to avoid
+            p.communicate(timeout=20)
+            log("probe child exited cleanly after SIGTERM")
+        except subprocess.TimeoutExpired:
+            log("WARNING: probe child ignored SIGTERM for 20s; SIGKILL "
+                "(this can poison the chip claim)")
+            p.kill()
+            p.communicate()
         return None
-    for line in r.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith("PROBE_OK"):
             return line.split()[2]
-    log("probe rc=%d stderr tail: %s" % (r.returncode, r.stderr[-300:]))
+    log("probe rc=%d stderr tail: %s" % (p.returncode, stderr[-300:]))
     return None
 
 
@@ -285,26 +311,28 @@ def init_backend():
     stage("backend-init")
     import jax
 
-    for attempt in range(1, INIT_RETRIES + 1):
-        plat = _probe_backend_subprocess(INIT_TIMEOUT_S)
+    for attempt, timeout_s in enumerate(INIT_SCHEDULE, 1):
+        plat = _probe_backend_subprocess(timeout_s)
         if plat is not None:
-            devs = _guarded_devices(jax, INIT_TIMEOUT_S)
+            devs = _guarded_devices(jax, max(INIT_TIMEOUT_S, timeout_s))
             log("backend up: %d x %s (attempt %d)" % (len(devs), plat, attempt))
             return jax, devs[0].platform, False
         log("backend init attempt %d failed: probe timeout/error (%ds)"
-            % (attempt, INIT_TIMEOUT_S))
-        time.sleep(2)
+            % (attempt, timeout_s))
+        # let a SIGTERMed probe child finish detaching before the next
+        # claimant dials in (concurrent claimants poison the claim)
+        time.sleep(10)
     # Accelerator unreachable -- fall back to CPU so a number exists.
     # The CPU backend has not been touched yet, so the platform override
     # still applies in-process.
-    log("falling back to CPU after %d failed attempts" % INIT_RETRIES)
+    log("falling back to CPU after %d failed attempts" % len(INIT_SCHEDULE))
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
     devs = jax.devices("cpu")
-    return jax, "cpu (accelerator probe failed %dx%ds)" % (
-        INIT_RETRIES, INIT_TIMEOUT_S), True
+    return jax, "cpu (accelerator probe failed %s s)" % (
+        "+".join(str(s) for s in INIT_SCHEDULE)), True
 
 
 def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False, scan_k=0):

@@ -1,0 +1,107 @@
+"""Pre-flight TPU tunnel health check (single-claimant, hang-proof).
+
+Run BEFORE any TPU job (bench.py, benchmarks/*, profiling) to classify
+the tunnel state without risking the job itself:
+
+    python tools/tpu_health.py [--timeout 90] [--json]
+
+Exit codes / classification:
+  0  healthy  — a subprocess claimed the chip, ran a matmul, fetched a
+               scalar, and released the claim inside the timeout.
+  4  wedged   — the probe child hung past the timeout (claim never
+               granted or fetch never returned). The child is SIGTERMed
+               (observed safe; SIGKILL is the documented poison trigger
+               and is only used if SIGTERM is ignored for 20s).
+  5  error    — the probe child exited with an error (plugin missing,
+               backend registration failure, ...).
+
+Why a subprocess: the axon PJRT client blocks in native code, so no
+in-process signal can interrupt a wedged init. Why a matmul + scalar
+fetch and not just ``jax.devices()``: the observed wedge mode passes
+init/compile and blocks only on the host fetch
+(docs/TPU_OPERATIONS.md), so a devices()-only check reports healthy on
+a tunnel that cannot complete a single step.
+
+Recovery protocol when wedged: docs/TPU_OPERATIONS.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+PROBE_CODE = r"""
+import time
+t0 = time.time()
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = float((x @ x).astype(jnp.float32)[0, 0])
+print("HEALTH_OK %s %s %.1f" % (
+    d[0].platform, getattr(d[0], "device_kind", "?"), time.time() - t0),
+    flush=True)
+"""
+
+
+def probe(timeout_s):
+    """Returns (state, detail_dict). Single claimant; graceful teardown."""
+    t0 = time.time()
+    p = subprocess.Popen(
+        [sys.executable, "-c", PROBE_CODE],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        stdout, stderr = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        p.terminate()
+        try:
+            # communicate, not wait: keep draining the pipes so a child
+            # that logs on SIGTERM can't block on a full pipe and force
+            # the SIGKILL (the claim-poison trigger) below
+            p.communicate(timeout=20)
+            kill = False
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            kill = True
+        return "wedged", {
+            "elapsed_s": round(time.time() - t0, 1),
+            "timeout_s": timeout_s,
+            "forced_sigkill": kill,
+            "note": "claim/fetch never completed; see docs/TPU_OPERATIONS.md",
+        }
+    for line in stdout.splitlines():
+        if line.startswith("HEALTH_OK"):
+            _, platform, kind, init_s = line.split(None, 3)
+            return "healthy", {
+                "platform": platform, "device_kind": kind,
+                "probe_s": float(init_s),
+                "elapsed_s": round(time.time() - t0, 1),
+            }
+    return "error", {
+        "rc": p.returncode,
+        "stderr_tail": stderr[-400:],
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+EXIT = {"healthy": 0, "wedged": 4, "error": 5}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=int, default=90)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    state, detail = probe(args.timeout)
+    if args.json:
+        print(json.dumps({"state": state, **detail}))
+    else:
+        print("tpu tunnel: %s  %s" % (state, detail))
+    return EXIT[state]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

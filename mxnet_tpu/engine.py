@@ -21,7 +21,6 @@ from __future__ import annotations
 import os
 import threading
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 
 from .base import MXNetError, get_env
 
@@ -29,47 +28,87 @@ from .base import MXNetError, get_env
 class Var:
     """A dependency variable with read/write queues (ThreadedVar)."""
 
-    __slots__ = ("_lock", "_queue", "_pending_write", "_num_pending_reads")
+    __slots__ = ("_lock", "_queue", "_pending_write", "_num_pending_reads",
+                 "_last_opr")
 
     def __init__(self):
         self._lock = threading.Lock()
         self._queue = deque()  # of _OprBlock waiting on this var
         self._pending_write = False
         self._num_pending_reads = 0
-
+        self._last_opr = None  # most recently PUSHED op touching this var
 
 class _OprBlock:
-    __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "done", "lock")
+    __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "done", "lock",
+                 "priority", "name")
 
-    def __init__(self, fn, const_vars, mutable_vars):
+    def __init__(self, fn, const_vars, mutable_vars, priority=0, name=None):
         self.fn = fn
         self.const_vars = const_vars
         self.mutable_vars = mutable_vars
         self.wait = 0
         self.done = threading.Event()
         self.lock = threading.Lock()
+        self.priority = priority
+        self.name = name
 
 
 class ThreadedEngine:
-    """Asynchronous host-side dependency engine (ThreadedEnginePooled)."""
+    """Asynchronous host-side dependency engine (ThreadedEnginePooled).
+
+    Ready-to-run ops dispatch through a PRIORITY heap (higher ``priority``
+    runs first when workers are contended), the discipline the reference
+    uses to overlap gradient communication with backward: push(key,
+    priority=-param_index) makes the front layers' reduces jump the queue
+    so the next forward can start sooner (reference
+    src/kvstore/comm.h kCPUPrioritized reduce + engine PushAsync
+    priority)."""
 
     def __init__(self, num_workers=None):
         if num_workers is None:
             num_workers = get_env("MXNET_CPU_WORKER_NTHREADS", 4)
-        self._pool = ThreadPoolExecutor(max_workers=num_workers)
         self._lock = threading.Lock()
         self._inflight = 0
         self._all_done = threading.Condition(self._lock)
+        self._ready = []  # heap of (-priority, seq, opr)
+        self._ready_cv = threading.Condition()
+        self._seq = 0
+        self._trace = None  # list when tracing, else None
+        # op exceptions: recorded here (workers never die from an op
+        # failure) and re-raised on the CALLER's thread by
+        # raise_pending() — kvstore calls it at every API entry, so a
+        # failed async push/pull stops training deterministically
+        # instead of silently dropping updates
+        self._errors = []
+        self._workers = []
+        for i in range(num_workers):
+            t = threading.Thread(
+                target=self._worker, daemon=True,
+                name="mxtpu-engine-%d" % i)
+            t.start()
+            self._workers.append(t)
 
     def new_variable(self):
         return Var()
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    # -- tracing (test/diagnostic hook: records execution order) --------
+    def start_trace(self):
+        """Begin recording executed ops as dicts (name, priority, start,
+        end, thread). Returns the live list; stop_trace() detaches it."""
+        self._trace = []
+        return self._trace
+
+    def stop_trace(self):
+        t, self._trace = self._trace, None
+        return t
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             name=None):
         """Schedule fn once all vars' prior conflicting ops complete."""
         const_vars = list(const_vars)
         mutable_vars = list(mutable_vars)
         self._check_duplicate(const_vars, mutable_vars)
-        opr = _OprBlock(fn, const_vars, mutable_vars)
+        opr = _OprBlock(fn, const_vars, mutable_vars, priority, name)
         with self._lock:
             self._inflight += 1
         # Self-hold refcount: opr.wait starts at 1 so a producer that
@@ -80,6 +119,7 @@ class ThreadedEngine:
         opr.wait = 1
         for var in const_vars:
             with var._lock:
+                var._last_opr = opr
                 if var._pending_write or var._queue:
                     with opr.lock:
                         opr.wait += 1
@@ -88,6 +128,7 @@ class ThreadedEngine:
                     var._num_pending_reads += 1
         for var in mutable_vars:
             with var._lock:
+                var._last_opr = opr
                 if var._pending_write or var._num_pending_reads or var._queue:
                     with opr.lock:
                         opr.wait += 1
@@ -112,13 +153,53 @@ class ThreadedEngine:
                 )
 
     def _dispatch(self, opr):
-        self._pool.submit(self._execute, opr)
+        import heapq
+
+        with self._ready_cv:
+            heapq.heappush(self._ready, (-opr.priority, self._seq, opr))
+            self._seq += 1
+            self._ready_cv.notify()
+
+    def _worker(self):
+        import heapq
+
+        while True:
+            with self._ready_cv:
+                while not self._ready:
+                    self._ready_cv.wait()
+                _, _, opr = heapq.heappop(self._ready)
+            self._execute(opr)
 
     def _execute(self, opr):
+        import sys
+        import time as _time
+        import traceback
+
+        t0 = _time.monotonic()
         try:
             opr.fn()
+        except BaseException as e:  # noqa: BLE001 — worker must survive
+            # A raising op must NOT kill the worker (a dead worker
+            # eventually deadlocks every dependent op); record for
+            # raise_pending() and keep going.
+            self._errors.append(e)
+            traceback.print_exc(file=sys.stderr)
         finally:
+            trace = self._trace
+            if trace is not None:
+                trace.append({
+                    "name": opr.name, "priority": opr.priority,
+                    "start": t0, "end": _time.monotonic(),
+                    "thread": threading.current_thread().name,
+                })
             self._on_complete(opr)
+
+    def raise_pending(self):
+        """Re-raise the first recorded async-op exception on the
+        caller's thread (clearing the queue). No-op if none."""
+        if self._errors:
+            errs, self._errors = self._errors, []
+            raise errs[0]
 
     def _on_complete(self, opr):
         """CompleteReadDependency/CompleteWriteDependency + trigger
@@ -170,6 +251,16 @@ class ThreadedEngine:
         self.push(done.set, const_vars=[var])
         done.wait()
 
+    def wait_last(self, var):
+        """Cheaper read-barrier: wait for the most recently PUSHED op on
+        var (whose completion implies every earlier WRITE on var is
+        done — var grants are FIFO). Used by NDArray._drain_engine on
+        the per-batch hot path, where pushing a sentinel op per array
+        per step (wait_for_var) measurably costs throughput."""
+        opr = var._last_opr
+        if opr is not None:
+            opr.done.wait()
+
     def wait_for_all(self):
         with self._lock:
             while self._inflight:
@@ -182,14 +273,27 @@ class NaiveEngine:
     def new_variable(self):
         return Var()
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             name=None):
         fn()
+
+    def raise_pending(self):
+        pass
 
     def wait_for_var(self, var):
         pass
 
+    def wait_last(self, var):
+        pass
+
     def wait_for_all(self):
         pass
+
+    def start_trace(self):
+        return []
+
+    def stop_trace(self):
+        return []
 
 
 _ENGINE = None
@@ -217,3 +321,31 @@ def get():
             except Exception:
                 _ENGINE = ThreadedEngine()
     return _ENGINE
+
+
+_COMM_ENGINE = None
+
+
+def comm():
+    """The COMMUNICATION engine: schedules KVStore push/pull host work
+    (reduce, cross-process allreduce, optimizer update, broadcast-copy)
+    so gradient sync overlaps the python train loop the way the
+    reference's engine-scheduled kvstore ops overlap backward
+    (src/kvstore/comm.h kCPUPrioritized; SURVEY §5.8 "the key scheduling
+    idea to preserve").
+
+    Always the python ThreadedEngine (or NaiveEngine under
+    MXNET_ENGINE_TYPE=NaiveEngine — the same synchronous debug toggle
+    governs both engines): comm ops are chunky host-side reductions
+    where dispatch overhead is irrelevant, and the python engine carries
+    the priority heap + execution trace the kvstore tests assert on.
+    Separate from get() so IO prefetch load can never starve gradient
+    sync (the reference likewise splits IO and comm thread pools)."""
+    global _COMM_ENGINE
+    if _COMM_ENGINE is None:
+        if os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine":
+            _COMM_ENGINE = NaiveEngine()
+        else:
+            _COMM_ENGINE = ThreadedEngine(
+                get_env("MXNET_KVSTORE_NTHREADS", 4))
+    return _COMM_ENGINE

@@ -625,11 +625,19 @@ class Executor:
             for k, v in kwargs.items():
                 if k not in arg_dict:
                     raise MXNetError("unknown input %s" % k)
+                if arg_dict[k]._engine_dep is not None:
+                    arg_dict[k]._drain_engine()  # don't race a pull
                 if isinstance(v, NDArray):
                     arg_dict[k]._data = v._data
                 else:
                     arg_dict[k]._data = nd.array(v)._data
         rng = _random.next_key() if self._needs_rng else None
+        # kvstore-managed weights may have an engine-scheduled pull still
+        # in flight (the overlap this framework preserves from the
+        # reference's prioritized comm engine); drain before snapshotting
+        for a in self.arg_arrays:
+            if a._engine_dep is not None:
+                a._drain_engine()
         arg_vals = tuple(a._data for a in self.arg_arrays)
         aux_vals = tuple(a._data for a in self.aux_arrays)
         self._stash = (arg_vals, aux_vals, rng, bool(is_train))
@@ -684,6 +692,11 @@ class Executor:
         if self._stash is not None:
             arg_vals, aux_vals, rng, _ = self._stash
         else:
+            # same in-flight-pull drain as forward(): backward without a
+            # prior forward must not snapshot stale weights
+            for a in self.arg_arrays:
+                if a._engine_dep is not None:
+                    a._drain_engine()
             arg_vals = tuple(a._data for a in self.arg_arrays)
             aux_vals = tuple(a._data for a in self.aux_arrays)
             rng = _random.next_key() if self._needs_rng else None

@@ -15,16 +15,35 @@ redesign per SURVEY.md §5.8: the parameter-server tier is deleted —
   so training scripts run unmodified.
 
 The key scheduling idea the reference encodes — push/pull are async engine
-ops with priority = -param_index so backward-order layers sync first
-(SURVEY.md §5.8) — is preserved by XLA latency-hiding scheduling when sync
-happens inside the step; the explicit `priority` argument is accepted for
-API parity.
+ops with priority = -param_index so front layers' syncs jump the queue and
+overlap the rest of the train loop (SURVEY.md §5.8 "the key scheduling
+idea to preserve"; reference src/kvstore/comm.h kCPUPrioritized +
+python/mxnet/kvstore.py push(priority)) — is preserved two ways:
+
+- fused path (ShardedTrainStep): sync happens inside the compiled step;
+  XLA's latency-hiding scheduler owns the overlap.
+- executor path (THIS class): push/pull are scheduled on the
+  communication engine (engine.comm()) with the caller's priority and a
+  per-key dependency Var, so the python thread returns immediately, the
+  host reduce / cross-process allreduce / optimizer update runs on comm
+  workers, and the next forward only waits for the specific weights it
+  reads (NDArray engine-var discipline). Cross-process ops additionally
+  chain on one Var so every rank issues collectives in the same order —
+  a hard correctness requirement for collective-based allreduce that the
+  reference's server tier never had to face (priority therefore cannot
+  reorder DIST ops, only local ones).
+
+MXNET_KVSTORE_ASYNC=0 restores the fully synchronous path (and
+MXNET_ENGINE_TYPE=NaiveEngine makes every engine synchronous, same as
+the reference's debug toggle).
 """
 from __future__ import annotations
 
 import os
 import pickle
+import threading
 
+from . import engine as _engine
 from . import ndarray as nd
 from . import optimizer as opt
 from .base import MXNetError
@@ -50,6 +69,13 @@ class KVStore(object):
         self._updater = None
         self._barrier_count = 0
         self._heartbeat = None
+        self._key_vars = {}  # key -> engine Var (per-key push/pull order)
+        self._update_lock = threading.Lock()  # updater/store mutation
+        self._dist_chain = None  # lazily: serializes cross-process ops
+        if os.environ.get("MXNET_KVSTORE_ASYNC", "1") == "0":
+            self._comm = _engine.NaiveEngine()
+        else:
+            self._comm = _engine.comm()
         # Multi-process distributed rank/size come from the JAX runtime
         # itself once a dist store is requested (the env names are only
         # the pre-init fallback): trusting env alone let round-2 report
@@ -102,11 +128,23 @@ class KVStore(object):
                              ctx=v.context, dtype=v.dtype)
             self._store[k] = v.copy()
 
+    def _key_var(self, k):
+        var = self._key_vars.get(k)
+        if var is None:
+            var = self._comm.new_variable()
+            self._key_vars[k] = var
+        return var
+
     def push(self, key, value, priority=0):
         """Reduce value(s) into the store; updater applies if set.
+
         Parity: KVStoreLocal::Push (kvstore_local.h) — merged = sum over
         the per-device list (Comm::Reduce), then updater(key, merged,
-        stored) or plain store write."""
+        stored) or plain store write. The whole body is an ASYNC comm-
+        engine op (write on the key's Var, priority honored for local
+        stores), so the caller's thread keeps dispatching — the overlap
+        the reference gets from engine-scheduled kvstore ops."""
+        self._comm.raise_pending()  # surface earlier async-op failures
         if self._heartbeat is not None:
             # progress beat from the hot path: a rank wedged in a
             # collective stops marking progress even though its liveness
@@ -115,37 +153,117 @@ class KVStore(object):
         for k, vals in _ctype_key_value(key, value):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
-            merged = self._reduce(vals)
-            if self._is_dist:
-                # Cross-worker merge (the server-side merge_buf_ sum in
-                # kvstore_dist_server.h:163-200, minus the server): every
-                # worker contributes, every worker sees the global sum.
-                # dist_async gets the same synchronous reduction — with
-                # no PS tier there is no one-sided push target, and sync
-                # semantics are strictly stronger.
+            # Resolved on the CALLER's thread: _str_key assigns updater
+            # indices in first-seen order, which must be the script's
+            # deterministic push order, not the workers' race order.
+            upd_key = k if isinstance(k, int) else self._str_key(k)
+            # Snapshot the jax arrays now — they are immutable values, so
+            # the body is immune to the trainer overwriting the grad
+            # NDArrays (next backward) before the op runs.
+            snap = [NDArray(v._data) for v in vals]
+
+            def _apply(merged, k, upd_key):
+                with self._update_lock:
+                    if self._updater is not None:
+                        self._updater(upd_key, merged, self._store[k])
+                    else:
+                        merged.copyto(self._store[k])
+
+            if not self._is_dist:
+                def _do_push(snap=snap, k=k, upd_key=upd_key):
+                    _apply(self._reduce(snap), k, upd_key)
+
+                self._comm.push(_do_push, mutable_vars=[self._key_var(k)],
+                                priority=priority, name="push:%s" % k)
+                continue
+            # DIST: two pipelined stages, the reference's Reduce -> server
+            # push structure (kvstore_local.h Comm::Reduce, then the
+            # merge_buf_ sum of kvstore_dist_server.h:163-200 minus the
+            # server tier). Stage 1 (per-key var): local multi-device
+            # reduce + host fetch — runs CONCURRENTLY across keys.
+            # Stage 2 (key var + ONE chain var): gloo allreduce + update.
+            # The chain makes every rank issue collectives in schedule
+            # order — a hard correctness requirement for collective
+            # allreduce (no server to absorb reordering), so priority
+            # cannot reorder dist collectives; it still orders stage 1.
+            # The pipeline win: key k+1's local reduce/fetch overlaps
+            # key k's cross-process allreduce.
+            box = {}
+
+            def _local_reduce(snap=snap, box=box):
+                try:
+                    merged = self._reduce(snap)
+                    box["host"] = merged.asnumpy()
+                    box["ctx"] = merged.context
+                    box["dtype"] = merged.dtype
+                except BaseException as e:  # noqa: BLE001
+                    # stage 2 must still ENTER the collective (peers are
+                    # already committed to it — bailing here would wedge
+                    # every other rank in gloo); it contributes zeros
+                    # and the error surfaces on the caller's thread via
+                    # raise_pending at the next kvstore call.
+                    box["error"] = e
+                    raise
+
+            def _allreduce_apply(box=box, k=k, upd_key=upd_key,
+                                 snap0=snap[0]):
                 from .parallel import mesh as _mesh
 
-                merged = nd.array(_mesh.allreduce_sum(merged.asnumpy()),
-                                  ctx=merged.context, dtype=merged.dtype)
-            if self._updater is not None:
-                self._updater(
-                    k if isinstance(k, int) else self._str_key(k), merged,
-                    self._store[k]
-                )
-            else:
-                merged.copyto(self._store[k])
+                if "error" in box:
+                    import numpy as _np
+
+                    _mesh.allreduce_sum(
+                        _np.zeros(snap0.shape, dtype=snap0.dtype))
+                    return  # error already recorded by stage 1
+                merged = nd.array(
+                    _mesh.allreduce_sum(box.pop("host")),
+                    ctx=box.pop("ctx"), dtype=box.pop("dtype"))
+                _apply(merged, k, upd_key)
+
+            if self._dist_chain is None:
+                self._dist_chain = self._comm.new_variable()
+            kv_var = self._key_var(k)
+            self._comm.push(_local_reduce, mutable_vars=[kv_var],
+                            priority=priority, name="reduce:%s" % k)
+            self._comm.push(_allreduce_apply,
+                            mutable_vars=[kv_var, self._dist_chain],
+                            priority=priority, name="push:%s" % k)
 
     def pull(self, key, out=None, priority=0):
-        """Broadcast stored value to out array(s) (Comm::Broadcast)."""
+        """Broadcast stored value to out array(s) (Comm::Broadcast).
+        Async like push: reads the key's Var (so it orders after the
+        in-flight push of the same key), writes the out arrays' Vars;
+        any reader of those NDArrays (executor forward, asnumpy) drains
+        automatically."""
         assert out is not None
+        self._comm.raise_pending()
         if self._heartbeat is not None:
             self._heartbeat.progress()
         for k, outs in _ctype_key_value(key, out):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
-            stored = self._store[k]
+
+            def _do_pull(k=k, outs=outs):
+                import jax
+
+                stored = self._store[k]
+                for o in outs:
+                    # direct _data write, NOT copyto: copyto drains the
+                    # target's engine var, which is held by THIS op —
+                    # calling it here would self-deadlock
+                    o._data = jax.device_put(stored._data,
+                                             o._data.device)
+
+            out_vars = []
+            seen = set()
             for o in outs:
-                stored.copyto(o)
+                var = o._engine_var(self._comm)
+                if id(var) not in seen:
+                    seen.add(id(var))
+                    out_vars.append(var)
+            self._comm.push(_do_pull, const_vars=[self._key_var(k)],
+                            mutable_vars=out_vars, priority=priority,
+                            name="pull:%s" % k)
 
     def _str_key(self, k):
         """Stable string-key → updater-index mapping (insertion order;
@@ -170,6 +288,7 @@ class KVStore(object):
 
     # ------------------------------------------------------------------
     def set_updater(self, updater):
+        self._comm.wait_for_all()  # in-flight pushes use the old updater
         self._updater = updater
 
     _set_updater = set_updater
@@ -189,6 +308,7 @@ class KVStore(object):
             clone = copy.copy(optimizer)  # caller's object untouched
             clone.sym = None
             optimizer = pickle.loads(pickle.dumps(clone))
+        self._comm.wait_for_all()
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
 
@@ -213,6 +333,7 @@ class KVStore(object):
         exists to synchronize (round-1/2 finding, fixed)."""
         if self._heartbeat is not None:
             self._heartbeat.progress()
+        self._comm.wait_for_all()  # a barrier implies local quiescence
         if self._size > 1:
             from .parallel import barrier as _mesh_barrier
 
@@ -222,12 +343,14 @@ class KVStore(object):
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
+        self._comm.wait_for_all()  # states must include in-flight updates
         with open(fname, "wb") as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot load states for distributed training")
+        self._comm.wait_for_all()
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 

@@ -146,7 +146,8 @@ class NativeEngine:
     def new_variable(self):
         return self._lib.engine_new_var(self._h)
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             name=None):
         from .base import MXNetError
 
         # reference ThreadedEngine::CheckDuplicate parity: overlapping or
@@ -173,6 +174,9 @@ class NativeEngine:
             self._h, ctypes.cast(self._c_dispatch, ctypes.c_void_p),
             ctypes.c_void_p(cb_id), c_arr, n_c, m_arr, n_m,
         )
+
+    def raise_pending(self):
+        pass  # native ops report failure via their own callbacks
 
     def wait_for_var(self, var):
         self._lib.engine_wait_for_var(self._h, var)

@@ -106,6 +106,8 @@ def imperative_invoke(opdef, inputs, attrs, out=None):
     arrays = []
     for x in inputs:
         if isinstance(x, NDArray):
+            if x._engine_dep is not None:  # kvstore-managed array
+                x._drain_engine()
             arrays.append(x._data)
         else:
             arrays.append(np.asarray(x))
@@ -169,12 +171,16 @@ def imperative_invoke(opdef, inputs, attrs, out=None):
 class NDArray:
     """An n-dimensional array on a device, with async-op semantics."""
 
-    __slots__ = ("_data",)
+    __slots__ = ("_data", "_engine_dep")
     # prefer our operators over numpy's in mixed expressions
     __array_priority__ = 1000.0
 
     def __init__(self, data):
         self._data = data
+        # (engine, Var) when a host-side engine op (KVStore push/pull)
+        # has claimed this array; None for the overwhelmingly common
+        # case where jax's value tracking is the only discipline needed
+        self._engine_dep = None
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -209,12 +215,36 @@ class NDArray:
         return imperative_invoke("transpose", [self], {})
 
     # -- sync ---------------------------------------------------------------
+    def _engine_var(self, eng):
+        """Attach (or return) this array's dependency Var on engine
+        ``eng``. Engine-scheduled host ops (KVStore push/pull) declare
+        reads/writes through it; readers drain via _drain_engine."""
+        dep = self._engine_dep
+        if dep is None or dep[0] is not eng:
+            dep = (eng, eng.new_variable())
+            self._engine_dep = dep
+        return dep[1]
+
+    def _drain_engine(self):
+        """Wait for any outstanding engine-scheduled op on this array
+        (no-op in the common case: one attribute check)."""
+        dep = self._engine_dep
+        if dep is not None:
+            eng, var = dep
+            wait_last = getattr(eng, "wait_last", None)
+            if wait_last is not None:
+                wait_last(var)
+            else:
+                eng.wait_for_var(var)
+
     def wait_to_read(self):
+        self._drain_engine()
         self._data.block_until_ready()
 
     wait_to_write = wait_to_read
 
     def asnumpy(self):
+        self._drain_engine()
         return np.asarray(self._data)
 
     def __array__(self, dtype=None, copy=None):
@@ -230,6 +260,7 @@ class NDArray:
             raise ValueError(
                 "NDArray.__array__: cannot guarantee zero-copy for "
                 "device-backed data (np.asarray(nd, copy=False))")
+        self._drain_engine()
         a = np.asarray(self._data)
         if dtype is not None and a.dtype != np.dtype(dtype):
             return a.astype(dtype, copy=True)
@@ -251,6 +282,12 @@ class NDArray:
         if isinstance(other, NDArray):
             if other is self:
                 return other
+            if other._engine_dep is not None:
+                # order this write after any in-flight engine op on the
+                # target. The kvstore pull body writes its target via
+                # _data assignment (not copyto) precisely so this drain
+                # can't self-deadlock the op that holds the var.
+                other._drain_engine()
             other._data = jax.device_put(self._data, other._data.device)
             return other
         if isinstance(other, Context):
@@ -291,6 +328,10 @@ class NDArray:
     def __setitem__(self, key, value):
         import jax.numpy as jnp
 
+        if self._engine_dep is not None:
+            # an in-flight engine op (kvstore pull) targeting this array
+            # must land BEFORE this write, or it would clobber it later
+            self._drain_engine()
         if isinstance(value, NDArray):
             v = value._data
         else:

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Inference throughput (images/sec) for the model zoo — the analog of
+the reference's example/image-classification/benchmark_score.py, which
+feeds random batches through a bound forward-only executor and reports
+img/s per (network, batch size).
+
+TPU redesign: the forward is ONE jitted XLA program; a K-step lax.scan
+wraps it so each dispatch amortizes the remote-tunnel latency and the
+wall rate IS the device rate (bench.py's scan-row technique). bf16
+inference is the default on TPU (the MXU's native rate); f32 rows via
+SCORE_F32=1.
+
+Run:       python benchmarks/benchmark_score.py
+Smoke:     SCORE_SMOKE=1 python benchmarks/benchmark_score.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = os.environ.get("SCORE_SMOKE") == "1"
+NETWORKS = os.environ.get(
+    "SCORE_NETS", "resnet-50" if not SMOKE else "resnet-18").split(",")
+BATCHES = [int(b) for b in os.environ.get(
+    "SCORE_BATCHES", "1,32,256" if not SMOKE else "2").split(",")]
+SCAN_K = int(os.environ.get("SCORE_SCAN_K", "2" if SMOKE else "16"))
+REPS = int(os.environ.get("SCORE_REPS", "1" if SMOKE else "3"))
+
+
+def get_symbol(name):
+    if name.startswith("resnet-"):
+        from mxnet_tpu.models.resnet import get_symbol as f
+
+        return f(num_classes=1000, num_layers=int(name.split("-")[1]))
+    if name == "inception-bn":
+        from mxnet_tpu.models.inception_bn import get_symbol as f
+
+        return f(num_classes=1000)
+    if name == "inception-v3":
+        from mxnet_tpu.models.inception_v3 import get_symbol as f
+
+        return f(num_classes=1000)
+    raise ValueError("unknown network %s" % name)
+
+
+def score(jax, jnp, name, batch, bf16):
+    from mxnet_tpu.executor import _GraphProgram
+
+    sym = get_symbol(name)
+    program = _GraphProgram(sym)
+    data_shape = (batch, 3, 224, 224)
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=data_shape, softmax_label=(batch,))
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if bf16 else jnp.float32
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        if n.endswith("_gamma"):
+            params[n] = jnp.ones(s, dt)
+        elif n.endswith(("_beta", "_bias")):
+            params[n] = jnp.zeros(s, dt)
+        else:
+            fan = int(np.prod(s[1:])) or 1
+            params[n] = jnp.asarray(
+                rng.randn(*s) * np.sqrt(2.0 / fan), dt)
+    aux = {n: (jnp.ones(s, jnp.float32) if n.endswith("var")
+               else jnp.zeros(s, jnp.float32))
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    label = jnp.zeros((batch,), jnp.float32)
+
+    def fwd(x):
+        args = dict(params)
+        args["data"] = x.astype(dt)
+        args["softmax_label"] = label
+        outs, _ = program(args, aux, None, False)
+        return outs[0]
+
+    def k_scan(x):
+        def body(c, _):
+            y = fwd(c)
+            # fold a whiff of the output back in: keeps every iteration
+            # live without changing what is measured
+            return c + 1e-6 * y.mean().astype(c.dtype), None
+        out, _ = jax.lax.scan(body, x, None, length=SCAN_K)
+        return out
+
+    run = jax.jit(k_scan)
+    x = jnp.asarray(rng.rand(*data_shape), jnp.float32)
+    out = run(x)
+    float(out.ravel()[0].astype(jnp.float32))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = run(out)
+    float(out.ravel()[0].astype(jnp.float32))
+    dtime = time.perf_counter() - t0
+    n_img = batch * SCAN_K * REPS
+    return n_img / dtime, 1000.0 * dtime / (SCAN_K * REPS)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    if SMOKE:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    rows = []
+    for name in NETWORKS:
+        for batch in BATCHES:
+            for bf16 in ([True, False] if (on_tpu and
+                         os.environ.get("SCORE_F32") == "1")
+                         else [on_tpu]):
+                img_s, step_ms = score(jax, jnp, name, batch, bf16)
+                rows.append({
+                    "network": name, "batch": batch,
+                    "dtype": "bf16" if bf16 else "f32",
+                    "images_per_sec": round(img_s, 1),
+                    "fwd_ms": round(step_ms, 3),
+                })
+                print(json.dumps(rows[-1]), file=sys.stderr)
+    out = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "scan_k": SCAN_K,
+        "reference_anchor": "example/image-classification/"
+                            "benchmark_score.py (K80 CUDA 7.5: resnet-50 "
+                            "~48 img/s fwd at batch 32 per its README era)",
+        "rows": rows,
+    }
+    tag = os.environ.get("SCORE_TAG", "smoke" if SMOKE else "v5e_r4")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results", "benchmark_score_%s.json" % tag)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": path, "rows": len(rows)}))
+
+
+if __name__ == "__main__":
+    main()

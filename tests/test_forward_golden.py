@@ -1,0 +1,72 @@
+"""Pretrained-forward goldens: end-to-end inference numerics pinned.
+
+Analog of the reference's pinned-inference net (tests/python/gpu/
+test_forward.py:36-60: load saved checkpoint, forward a stored batch,
+compare against stored outputs). The fixture (committed; generated once
+by tools/gen_golden_fixture.py) is a conv+BN+pool net in the
+byte-compatible dmlc checkpoint format with nontrivial BN moving stats,
+so symbol JSON load, .params decode, bind, and the inference math are
+all pinned together — any numerics regression anywhere in that stack
+fails this test.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+
+PREFIX = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures", "golden_convnet")
+
+
+def _load_io():
+    io = np.load(PREFIX + "_io.npz")
+    return io["data"], io["probs"]
+
+
+def test_checkpoint_forward_matches_golden():
+    sym, arg_params, aux_params = mx.model.load_checkpoint(PREFIX, 1)
+    data, golden = _load_io()
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null", data=data.shape)
+    for n, v in arg_params.items():
+        v.copyto(exe.arg_dict[n])
+    for n, v in aux_params.items():
+        v.copyto(exe.aux_dict[n])
+    exe.arg_dict["data"][:] = data
+    probs = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(probs, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_module_predict_matches_golden():
+    """Same goldens through the Module path (bind + set_params +
+    predict) — the route reference users actually take."""
+    sym, arg_params, aux_params = mx.model.load_checkpoint(PREFIX, 1)
+    data, golden = _load_io()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", data.shape)],
+             label_shapes=[("softmax_label", (data.shape[0],))],
+             for_training=False)
+    mod.set_params(arg_params, aux_params, allow_missing=True)
+    it = mx.io.NDArrayIter(data, np.zeros(data.shape[0], np.float32),
+                           batch_size=data.shape[0])
+    probs = mod.predict(it).asnumpy()
+    np.testing.assert_allclose(probs, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_params_bytes_stable():
+    """The committed .params must stay byte-identical under a read ->
+    write round trip (golden persists across writer refactors)."""
+    with open(PREFIX + "-0001.params", "rb") as f:
+        blob = f.read()
+    save_dict = mx.nd.load(PREFIX + "-0001.params")
+    tmp = PREFIX + "-roundtrip.params"
+    try:
+        mx.nd.save(tmp, save_dict)
+        with open(tmp, "rb") as f:
+            assert f.read() == blob
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)

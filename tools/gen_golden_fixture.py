@@ -1,0 +1,94 @@
+"""Generate the pretrained-forward golden fixture (run ONCE; committed).
+
+Analog of the reference's pinned-inference tests
+(tests/python/gpu/test_forward.py:36-60: load saved params, run
+forward, compare logits against stored goldens). This script creates:
+
+  tests/fixtures/golden_convnet-symbol.json   (network definition)
+  tests/fixtures/golden_convnet-0001.params   (dmlc-format weights)
+  tests/fixtures/golden_convnet_io.npz        (input batch + logits)
+
+tests/test_forward_golden.py then pins END-TO-END inference numerics
+forever: symbol load -> checkpoint load -> bind -> forward must
+reproduce the stored logits on any backend, any refactor. Params are
+seeded-random (the reference downloads trained zoo params; numerics
+pinning needs determinism, not accuracy).
+
+Regenerating (only if the fixture format itself must change):
+    python tools/gen_golden_fixture.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+
+def build_net(mx):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             name="conv1")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                             name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    fixdir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures")
+    os.makedirs(fixdir, exist_ok=True)
+    sym = build_net(mx)
+    rng = np.random.RandomState(7)
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=(2, 3, 16, 16))
+    arg_params, aux_params = {}, {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        if n.endswith("_gamma"):
+            v = 1.0 + 0.1 * rng.randn(*s)
+        elif n.endswith(("_beta", "_bias")):
+            v = 0.1 * rng.randn(*s)
+        else:
+            v = rng.randn(*s) * np.sqrt(2.0 / (np.prod(s[1:]) or 1))
+        arg_params[n] = mx.nd.array(v.astype(np.float32))
+    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        # nontrivial moving stats so BN inference math is really pinned
+        v = (np.abs(rng.randn(*s)) + 0.5 if n.endswith("var")
+             else 0.2 * rng.randn(*s))
+        aux_params[n] = mx.nd.array(v.astype(np.float32))
+
+    prefix = os.path.join(fixdir, "golden_convnet")
+    mx.model.save_checkpoint(prefix, 1, sym, arg_params, aux_params)
+
+    data = rng.rand(2, 3, 16, 16).astype(np.float32)
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                          data=(2, 3, 16, 16))
+    for n, v in arg_params.items():
+        v.copyto(exe.arg_dict[n])
+    for n, v in aux_params.items():
+        v.copyto(exe.aux_dict[n])
+    exe.arg_dict["data"][:] = data
+    probs = exe.forward(is_train=False)[0].asnumpy()
+    np.savez(prefix + "_io.npz", data=data, probs=probs)
+    print("wrote", prefix + "{-symbol.json,-0001.params,_io.npz}")
+    print("probs[0,:4] =", probs[0, :4])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Comm-volume accounting (benchmarks/scaling_model.py): the HLO
+all-reduce byte extraction must agree with first-principles gradient
+sizes, so the predicted weak-scaling curve (VERDICT r3 weak #6) rests on
+inspectable numbers rather than estimates.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from scaling_model import hlo_allreduce_bytes
+
+
+def test_parser_reads_allreduce_shapes():
+    hlo = """
+  %ar0 = f32[64,128] all-reduce(f32[64,128] %x), replica_groups={}
+  %t = (f32[256], f32[16,4]) all-reduce(f32[256] %a, f32[16,4] %b)
+  %rs = bf16[32] reduce-scatter(bf16[256] %c), dimensions={0}
+"""
+    sizes, counts = hlo_allreduce_bytes(hlo)
+    assert counts["all-reduce"] == 2
+    assert sizes["all-reduce"] == 64 * 128 * 4 + 256 * 4 + 16 * 4 * 4
+    assert counts["reduce-scatter"] == 1
+    assert sizes["reduce-scatter"] == 32 * 2
+
+
+def test_dp_step_allreduce_bytes_match_param_bytes():
+    """An 8-way dp MLP step must allreduce exactly one f32 gradient per
+    parameter — the property the ResNet-50 accounting relies on."""
+    from mxnet_tpu.parallel import ShardedTrainStep, make_mesh
+
+    mesh = make_mesh(dp=8)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    step = ShardedTrainStep(net, mesh, optimizer=opt)
+    rng = np.random.RandomState(0)
+    arg_shapes, _, _ = net.infer_shape(data=(16, 8), softmax_label=(16,))
+    host = {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.1)
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    params, aux = step.place_params(host, {})
+    opt_state = step.make_state(params)
+    batch = {
+        "data": jax.device_put(rng.rand(16, 8).astype(np.float32),
+                               step.batch_sharding()),
+        "softmax_label": jax.device_put(np.zeros(16, np.float32),
+                                        step.batch_sharding()),
+    }
+    step.compile()
+    hlo = step._step.lower(
+        params, aux, opt_state, batch, jnp.zeros((2,), jnp.uint32),
+        jnp.asarray(0.1, jnp.float32), jnp.asarray(1.0, jnp.float32)
+    ).compile().as_text()
+    sizes, _ = hlo_allreduce_bytes(hlo)
+    param_bytes = sum(int(np.prod(v.shape)) * 4 for v in host.values())
+    total = sum(sizes.values())
+    # one f32 allreduce per gradient; fusion may add a few scalar
+    # reductions (loss), hence the loose-but-meaningful band
+    assert 0.95 * param_bytes <= total <= 1.2 * param_bytes, (
+        total, param_bytes)

@@ -29,23 +29,7 @@ import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import recordio  # noqa: E402
 
 
-def pack_rec(tmpdir, n_images, size=224):
-    rng = np.random.RandomState(0)
-    rec = os.path.join(tmpdir, "bench.rec")
-    idx = os.path.join(tmpdir, "bench.idx")
-    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
-    # realistic JPEG entropy: smooth gradients + noise, not pure noise
-    # (pure noise decodes slower and compresses terribly)
-    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
-    for i in range(n_images):
-        base = (127 + 60 * np.sin(xx / (7 + i % 13))
-                + 40 * np.cos(yy / (11 + i % 7)))
-        img = np.clip(base[..., None] + rng.randn(size, size, 3) * 20,
-                      0, 255).astype(np.uint8)
-        writer.write_idx(i, recordio.pack_img(
-            recordio.IRHeader(0, float(i % 1000), i, 0), img))
-    writer.close()
-    return rec, idx
+from rec_utils import pack_rec  # noqa: E402,F401 — shared, side-effect-free
 
 
 def measure_iter(make_iter, n_images, epochs=2):

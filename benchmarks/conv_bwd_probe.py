@@ -94,8 +94,11 @@ def build_pass(jax, jnp, pass_name, layout, dtype,
     dn = _dn(layout)
     n, c, h, w = dshape
     o, cg, kh, kw = wshape
-    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
-    ow = (w + 2 * pad[1] - kw) // stride[1] + 1
+    # pad entries: int (symmetric) or (lo, hi) — the s2d stem needs
+    # asymmetric padding to stay mathematically equivalent
+    pads = [p if isinstance(p, tuple) else (p, p) for p in pad]
+    oh = (h + sum(pads[0]) - kh) // stride[0] + 1
+    ow = (w + sum(pads[1]) - kw) // stride[1] + 1
     if layout == "NCHW":
         x_shape, w_shape2, y_shape = dshape, wshape, (n, o, oh, ow)
     else:
@@ -105,7 +108,7 @@ def build_pass(jax, jnp, pass_name, layout, dtype,
     def conv(x, wt):
         return jax.lax.conv_general_dilated(
             x, wt, window_strides=stride,
-            padding=[(p, p) for p in pad],
+            padding=pads,
             dimension_numbers=dn, feature_group_count=groups)
 
     rng = np.random.RandomState(0)
@@ -202,6 +205,35 @@ def main():
                           % (str(dshape), dt_name, layout, p, ms, tf,
                              100 * tf / PEAK_TFLOPS, mult), file=sys.stderr)
 
+    # Stem space-to-depth experiment (MLPerf resnet-on-TPU trick): the
+    # 7x7/s2 conv on C=3 wastes the MXU's 128 lanes; reshaping input
+    # 224x224x3 -> 112x112x12 (2x2 space-to-depth) and zero-padding the
+    # kernel 7x7 -> 8x8 gives the mathematically equivalent 4x4/s1 conv
+    # on C=12. Time both stems in every pass to see what the swap buys.
+    s2d_rows = []
+    for p in passes:
+        for label, dshape, wshape, stride, pad in (
+            ("stem_std", (BATCH, 3, 224, 224), (64, 3, 7, 7),
+             (2, 2), (3, 3)),
+            # 7x7/s2 pad 3 == (in s2d space) 4x4/s1 with the 8x8
+            # zero-padded kernel and ASYMMETRIC pad (1,2): 224+6-7 over
+            # stride 2 -> 112 outputs, 112+3-4 over stride 1 -> 112
+            ("stem_s2d", (BATCH, 12, 112, 112), (64, 12, 4, 4),
+             (1, 1), ((1, 2), (1, 2))),
+        ):
+            try:
+                fn, init = build_pass(
+                    jax, jnp, p, "NHWC", dtypes[0][1],
+                    dshape, wshape, stride, pad, 1)
+                ms = time_pass(jax, jnp, fn, init)
+                s2d_rows.append({"exp": label, "pass": p,
+                                 "ms": round(ms, 3)})
+                print("%-9s %-5s %8.3f ms" % (label, p, ms),
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001
+                s2d_rows.append({"exp": label, "pass": p,
+                                 "error": str(e)[:160]})
+
     summary = {
         "%s_%s_%s_total_ms" % k: round(v, 2) for k, v in totals.items()
     }
@@ -210,6 +242,7 @@ def main():
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
         "summary_weighted_ms": summary,
+        "stem_space_to_depth": s2d_rows,
         "rows": rows,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

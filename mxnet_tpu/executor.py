@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +74,9 @@ def _compute_node(node, attrs, in_vals, is_train):
     return node.op.fcompute(attrs, in_vals, is_train)
 
 
+_MIRROR_SAVE_DEFAULT = "dot_general,conv_general_dilated"
+
+
 def _mirror_policy(prim, *_args, **_params):
     """Which residuals to SAVE under memory mirroring. The reference
     recomputes every op in backward except Convolution / FullyConnected /
@@ -80,8 +84,17 @@ def _mirror_policy(prim, *_args, **_params):
     the MXU-expensive results, rematerialize the bandwidth-cheap ones
     (activations, BN, pooling). The XLA translation: save dot/conv
     primitive outputs, recompute everything else. (Dropout recompute is
-    safe here: masks come from deterministic per-node fold_in keys.)"""
-    return prim.name in ("dot_general", "conv_general_dilated")
+    safe here: masks come from deterministic per-node fold_in keys.)
+
+    MXNET_MIRROR_SAVE tunes the saved set (comma-separated primitive
+    names) — the knob benchmarks/mirror_inception.py sweeps to trade
+    recompute time against activation memory, e.g. adding
+    reduce_window_max,reduce_window_sum (pooling) or concatenate
+    (the reference's Concat) cuts the recompute chains at extra pins.
+    Read per call (trace-time only) so a sweep can change it between
+    compiles without cache invalidation."""
+    names = os.environ.get("MXNET_MIRROR_SAVE", _MIRROR_SAVE_DEFAULT)
+    return prim.name in {n.strip() for n in names.split(",") if n.strip()}
 
 
 def _node_attrs(program, node, rng):

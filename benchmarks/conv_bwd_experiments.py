@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Step-level A/B of the conv-backward levers on the real chip.
+
+Runs the SAME bf16 b256 ResNet-50 scan-row measurement (bench.py's
+device-rate technique) under each candidate and prints one JSON line
+with all rows — one process, one tunnel claim, no subprocess sweeps
+(XLA_FLAGS-style sweeps need a fresh process per config, which multiplies
+claim cycles; the in-process env knobs below don't).
+
+Candidates:
+  baseline            current default
+  conv_bwd_nhwc       MXNET_CONV_BWD_LAYOUT=NHWC (backward convs in
+                      explicit NHWC, ops/nn.py _conv2d_bwd_nhwc)
+
+Run: python benchmarks/conv_bwd_experiments.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = int(os.environ.get("EXP_BATCH", "256"))
+SCAN_K = int(os.environ.get("EXP_SCAN_K", "8"))
+DISPATCHES = int(os.environ.get("EXP_DISPATCHES", "3"))
+
+
+def measure(jax, jnp, tag, env):
+    import bench
+
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        if v is None:  # None = explicitly UNSET for this row
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        t0 = time.perf_counter()
+        img_s, step_ms, _, _ = bench.run_resnet50(
+            jax, jnp, BATCH, DISPATCHES, 1, bf16=True, scan_k=SCAN_K)
+        return {"tag": tag, "images_per_sec": round(img_s, 2),
+                "step_ms": round(step_ms, 2),
+                "wall_s": round(time.perf_counter() - t0, 1)}
+    except Exception as e:  # noqa: BLE001 — record and continue sweep
+        return {"tag": tag, "error": str(e)[:300]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main():
+    import jax
+
+    if os.environ.get("EXP_SMOKE") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    rows = [
+        # explicit None: a flag inherited from the caller's shell must
+        # not silently turn the baseline row into the lever row
+        measure(jax, jnp, "baseline", {"MXNET_CONV_BWD_LAYOUT": None}),
+        measure(jax, jnp, "conv_bwd_nhwc",
+                {"MXNET_CONV_BWD_LAYOUT": "NHWC"}),
+    ]
+    for r in rows:
+        print(json.dumps(r), file=sys.stderr)
+    out = {"batch": BATCH, "scan_k": SCAN_K,
+           "platform": dev.platform,
+           "device_kind": getattr(dev, "device_kind", "?"),
+           "rows": rows}
+    tag = os.environ.get("EXP_TAG", "v5e_r4")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results", "conv_bwd_experiments_%s.json" % tag)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": path, "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()

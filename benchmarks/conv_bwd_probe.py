@@ -175,6 +175,21 @@ def main():
     items = sorted(configs.items(), key=lambda kv: -conv_flops(*kv[0][:4]))
     if SMOKE:
         items = items[:2]
+    # PROBE_TOP bounds the compile count (each (config, pass, layout,
+    # dtype) is its own remote compile — the full 23-config sweep is
+    # ~138 compiles, beyond a safe tunnel budget). Dropped configs are
+    # logged so the sweep never silently reads as exhaustive.
+    top = int(os.environ.get("PROBE_TOP", "0"))
+    if top and len(items) > top:
+        dropped = items[top:]
+        print("PROBE_TOP=%d: dropping %d configs (%.1f%% of weighted "
+              "flops)" % (top, len(dropped),
+                          100 * sum(conv_flops(*k[:4]) * m
+                                    for k, m in dropped)
+                          / sum(conv_flops(*k[:4]) * m
+                                for k, m in items)),
+              file=sys.stderr)
+        items = items[:top]
     for (dshape, wshape, stride, pad, groups), mult in items:
         flops = conv_flops(dshape, wshape, stride, pad)
         for dt_name, dt in dtypes:

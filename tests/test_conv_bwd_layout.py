@@ -1,0 +1,96 @@
+"""MXNET_CONV_BWD_LAYOUT=NHWC: the backward-convs-in-NHWC custom_vjp
+(ops/nn.py _conv2d_bwd_nhwc, the conv-backward perf lever from the r3
+device trace) must be numerically identical to jax's default conv
+transpose on every shape class ResNet-50 uses: plain 3x3, strided,
+the 7x7 C=3 stem, dilated, and grouped.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import nn
+
+CASES = [
+    ((2, 8, 14, 14), (16, 8, 3, 3), (1, 1), (1, 1), (1, 1), 1),
+    ((2, 8, 15, 15), (16, 8, 3, 3), (2, 2), (1, 1), (1, 1), 1),
+    ((2, 3, 32, 32), (8, 3, 7, 7), (2, 2), (3, 3), (1, 1), 1),  # stem
+    ((2, 8, 14, 14), (8, 4, 3, 3), (1, 1), (1, 1), (2, 2), 2),
+]
+
+
+@pytest.mark.parametrize("dshape,wshape,stride,pad,dilate,groups", CASES)
+def test_nhwc_backward_matches_default(dshape, wshape, stride, pad,
+                                       dilate, groups):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*dshape), jnp.float32)
+    w = jnp.asarray(rng.randn(*wshape), jnp.float32)
+
+    def f_default(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=[(p, p) for p in pad],
+            rhs_dilation=dilate, dimension_numbers=nn._conv_dn(2),
+            feature_group_count=groups)
+
+    y0, vjp0 = jax.vjp(f_default, x, w)
+    ct = jnp.asarray(rng.randn(*y0.shape), jnp.float32)
+    gx0, gw0 = vjp0(ct)
+    y1, vjp1 = jax.vjp(
+        lambda x, w: nn._conv2d_bwd_nhwc(x, w, stride, pad, dilate,
+                                         groups), x, w)
+    gx1, gw1 = vjp1(ct)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx0), np.asarray(gx1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw0), np.asarray(gw1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_env_flag_routes_training_grads(monkeypatch):
+    """Full product path: executor grads with the flag on == off."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), stride=(2, 2), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, kernel=(1, 1), num_filter=4, name="c2")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 5, 16, 16).astype(np.float32)
+    lab = rng.randint(0, 3, 4).astype(np.float32)
+
+    def grads(flag):
+        if flag:
+            monkeypatch.setenv("MXNET_CONV_BWD_LAYOUT", "NHWC")
+        else:
+            monkeypatch.delenv("MXNET_CONV_BWD_LAYOUT", raising=False)
+        exe = net.simple_bind(ctx=mx.cpu(), data=(4, 5, 16, 16),
+                              softmax_label=(4,))
+        init = mx.initializer.Xavier()
+        r = np.random.RandomState(7)
+        for n, a in sorted(exe.arg_dict.items()):
+            if n in ("data", "softmax_label"):
+                continue
+            a[:] = r.randn(*a.shape).astype(np.float32) * 0.1
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["softmax_label"][:] = lab
+        exe.forward(is_train=True)
+        exe.backward()
+        return {n: g.asnumpy() for n, g in exe.grad_dict.items()
+                if g is not None}
+
+    g_off = grads(False)
+    g_on = grads(True)
+    assert set(g_off) == set(g_on)
+    for n in g_off:
+        np.testing.assert_allclose(g_off[n], g_on[n], rtol=1e-4,
+                                   atol=1e-5, err_msg=n)

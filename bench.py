@@ -349,7 +349,11 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
     from mxnet_tpu.executor import _GraphProgram
     from mxnet_tpu.models.resnet import get_symbol
 
-    sym = get_symbol(num_classes=1000, num_layers=50)
+    # BENCH_STEM_S2D=1: MLPerf space-to-depth stem (exact-equivalent
+    # model, tests/test_resnet_s2d.py) — the MXU-friendly form of the
+    # C=3 7x7/s2 stem conv
+    sym = get_symbol(num_classes=1000, num_layers=50,
+                     stem_s2d=os.environ.get("BENCH_STEM_S2D") == "1")
     program = _GraphProgram(sym)
     data_shape = (batch, 3, 224, 224)
     arg_shapes, _, aux_shapes = sym.infer_shape(

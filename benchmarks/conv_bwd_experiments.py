@@ -7,10 +7,14 @@ with all rows — one process, one tunnel claim, no subprocess sweeps
 (XLA_FLAGS-style sweeps need a fresh process per config, which multiplies
 claim cycles; the in-process env knobs below don't).
 
-Candidates:
+Candidates (4 rows, one fresh compile each — budget tunnel time
+accordingly):
   baseline            current default
   conv_bwd_nhwc       MXNET_CONV_BWD_LAYOUT=NHWC (backward convs in
                       explicit NHWC, ops/nn.py _conv2d_bwd_nhwc)
+  stem_s2d            BENCH_STEM_S2D=1 (exact-equivalent space-to-depth
+                      stem, models/resnet.py stem_s2d)
+  nhwc+s2d            both levers together
 
 Run: python benchmarks/conv_bwd_experiments.py
 """
@@ -63,12 +67,16 @@ def main():
     import jax.numpy as jnp
 
     dev = jax.devices()[0]
+    off = {"MXNET_CONV_BWD_LAYOUT": None, "BENCH_STEM_S2D": None}
     rows = [
         # explicit None: a flag inherited from the caller's shell must
-        # not silently turn the baseline row into the lever row
-        measure(jax, jnp, "baseline", {"MXNET_CONV_BWD_LAYOUT": None}),
+        # not silently turn the baseline row into a lever row
+        measure(jax, jnp, "baseline", dict(off)),
         measure(jax, jnp, "conv_bwd_nhwc",
-                {"MXNET_CONV_BWD_LAYOUT": "NHWC"}),
+                {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC"}),
+        measure(jax, jnp, "stem_s2d", {**off, "BENCH_STEM_S2D": "1"}),
+        measure(jax, jnp, "nhwc+s2d",
+                {"MXNET_CONV_BWD_LAYOUT": "NHWC", "BENCH_STEM_S2D": "1"}),
     ]
     for r in rows:
         print(json.dumps(r), file=sys.stderr)

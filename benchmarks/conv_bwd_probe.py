@@ -230,11 +230,12 @@ def main():
         for label, dshape, wshape, stride, pad in (
             ("stem_std", (BATCH, 3, 224, 224), (64, 3, 7, 7),
              (2, 2), (3, 3)),
-            # 7x7/s2 pad 3 == (in s2d space) 4x4/s1 with the 8x8
-            # zero-padded kernel and ASYMMETRIC pad (1,2): 224+6-7 over
-            # stride 2 -> 112 outputs, 112+3-4 over stride 1 -> 112
+            # 7x7/s2 pad 3 == (in s2d space) 4x4/s1 with the front-
+            # zero-padded kernel and ASYMMETRIC pad (2,1): 112 outputs
+            # either way; tap mapping proven exact in
+            # tests/test_resnet_s2d.py (models/resnet.convert_stem_to_s2d)
             ("stem_s2d", (BATCH, 12, 112, 112), (64, 12, 4, 4),
-             (1, 1), ((1, 2), (1, 2))),
+             (1, 1), ((2, 1), (2, 1))),
         ):
             try:
                 fn, init = build_pass(

@@ -58,8 +58,30 @@ def residual_unit(data, num_filter, stride, dim_match, name,
     return conv2 + shortcut
 
 
+def convert_stem_to_s2d(conv0_weight):
+    """Convert a trained standard-stem kernel (O, C, 7, 7) to the
+    space-to-depth stem's (O, 4C, 4, 4) — numerically EXACT, so zoo
+    checkpoints keep working under stem_s2d=True.
+
+    Derivation: y[i] = sum_p x[2i+p-3] w[p]. Writing the input index as
+    2M+dm (dm = parity) maps tap p to (U, dm) with p = 2U+dm-1 after
+    zero-padding w front-first to 8; the input needs asymmetric pad
+    (2, 1) in s2d space. Verified tap-exact in
+    tests/test_resnet_s2d.py."""
+    import numpy as np
+
+    w = conv0_weight.asnumpy() if hasattr(conv0_weight, "asnumpy") \
+        else np.asarray(conv0_weight)
+    o, c = w.shape[:2]
+    w8 = np.zeros((o, c, 8, 8), w.dtype)
+    w8[:, :, 1:, 1:] = w
+    return (w8.reshape(o, c, 4, 2, 4, 2).transpose(0, 1, 3, 5, 2, 4)
+            .reshape(o, c * 4, 4, 4))
+
+
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, dtype="float32"):
+           bottle_neck=True, bn_mom=0.9, dtype="float32",
+           stem_s2d=False):
     data = sym.Variable("data")
     (nchannel, height, width) = image_shape
     data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
@@ -73,6 +95,27 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                                no_bias=True, name="conv0")
+    elif stem_s2d:
+        # MLPerf resnet-on-TPU stem: the 7x7/s2 conv on C=3 starves the
+        # MXU's 128 lanes; 2x2 space-to-depth makes it the EXACT-
+        # equivalent 4x4/s1 conv on C=12 (see convert_stem_to_s2d for
+        # the tap mapping; asymmetric (2,1) pad preserves all 112
+        # outputs). XLA folds the Pad into the conv.
+        body = sym.Reshape(data, shape=(0, nchannel, height // 2, 2,
+                                        width // 2, 2))
+        body = sym.transpose(body, axes=(0, 1, 3, 5, 2, 4))
+        body = sym.Reshape(body, shape=(0, nchannel * 4, height // 2,
+                                        width // 2))
+        body = sym.Pad(body, pad_width=(0, 0, 0, 0, 2, 1, 2, 1),
+                       mode="constant")
+        body = sym.Convolution(body, num_filter=filter_list[0],
+                               kernel=(4, 4), stride=(1, 1), pad=(0, 0),
+                               no_bias=True, name="conv0")
+        body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                             name="bn0")
+        body = sym.Activation(body, act_type="relu", name="relu0")
+        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           pool_type="max")
     else:  # imagenet
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(7, 7), stride=(2, 2), pad=(3, 3),
@@ -104,7 +147,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
-               dtype="float32", **kwargs):
+               dtype="float32", stem_s2d=False, **kwargs):
     """Parity with the reference CLI surface: --num-layers picks depth."""
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
@@ -146,4 +189,5 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
         units=units, num_stages=num_stages, filter_list=filter_list,
         num_classes=num_classes, image_shape=image_shape,
         bottle_neck=bottle_neck, dtype=dtype,
+        stem_s2d=stem_s2d,
     )

@@ -46,6 +46,20 @@ INIT_SCHEDULE = tuple(
     int(s) for s in os.environ.get(
         "BENCH_INIT_SCHEDULE", "30,120,210").split(","))
 METRIC = "resnet50_train_images_per_sec_batch%d" % BATCH
+# Soft whole-run deadline: after the primary row, each OPTIONAL row
+# checks the elapsed budget and is skipped (with a note) rather than
+# risking the harness killing the process before emit. Distinct from
+# the stall guard (which handles no-progress wedges, not slow runs).
+DEADLINE_S = int(os.environ.get("BENCH_DEADLINE", "1500"))
+_T_START = time.monotonic()
+
+
+def over_deadline(out, row_name):
+    if time.monotonic() - _T_START <= DEADLINE_S:
+        return False
+    out.setdefault("rows_skipped_for_deadline", []).append(row_name)
+    log("deadline %ds exceeded; skipping %s" % (DEADLINE_S, row_name))
+    return True
 
 # Spec-sheet bf16 peak TFLOP/s per chip, keyed by substrings of
 # jax.devices()[0].device_kind (NEVER an env var -- the round-2 bench
@@ -663,6 +677,14 @@ def main():
         "platform": platform,
         "device_kind": kind,
     }
+    # perf-lever flags change the compiled graph: stamp them so result
+    # files can never silently mix lever-on and lever-off numbers
+    # (stem_s2d is an exact-equivalent model, tests/test_resnet_s2d.py,
+    # so vs_baseline remains comparable)
+    if os.environ.get("BENCH_STEM_S2D") == "1":
+        out["stem_s2d"] = True
+    if os.environ.get("MXNET_CONV_BWD_LAYOUT"):
+        out["conv_bwd_layout"] = os.environ["MXNET_CONV_BWD_LAYOUT"]
     if on_tpu:
         # armed BEFORE the first real device work (calibration fetches
         # go through the same tunnel that wedges)
@@ -740,7 +762,7 @@ def main():
     # true small-batch device rate instead of estimating it)
     if on_tpu:
         scan_k32 = int(os.environ.get("BENCH_SCAN_K", "8"))
-        if scan_k32 > 1:
+        if scan_k32 > 1 and not over_deadline(out, "scan_b%d" % BATCH):
             try:
                 img_s_s, step_ms_s, _, _ = run_resnet50(
                     jax, jnp, BATCH, 3, 1, scan_k=scan_k32)
@@ -760,7 +782,8 @@ def main():
 
     # Secondary large-batch row: batch 32 at ~1 ms/step is latency-bound
     # and says little about sustained utilization.
-    if on_tpu and BATCH2 > BATCH:
+    if on_tpu and BATCH2 > BATCH and not over_deadline(
+            out, "batch%d_and_all_downstream_rows" % BATCH2):
         try:
             img_s2, step_ms2, flops2, ovh2 = run_resnet50(
                 jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP)
@@ -776,22 +799,26 @@ def main():
         # bf16 mixed-precision row (reference fp16 recipe, TPU dtype):
         # this is the configuration the MXU is built for
         flops3 = None
-        try:
-            img_s3, step_ms3, flops3, ovh3 = run_resnet50(
-                jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP, bf16=True)
-            out["bf16_batch%d_images_per_sec" % BATCH2] = round(img_s3, 2)
-            out["bf16_batch%d_step_ms" % BATCH2] = round(step_ms3, 2)
-            out.update(mfu_fields(
-                "bf16_batch%d_" % BATCH2, step_ms3, flops3, peak))
-            out.update(_device_est("bf16_batch%d_" % BATCH2, step_ms3,
-                                   flops3, ovh3))
-        except Exception as e:
-            log("bf16 run failed: %s" % e)
-            out["bf16_error"] = str(e)[:200]
+        if not over_deadline(out, "bf16_batch%d" % BATCH2):
+            try:
+                img_s3, step_ms3, flops3, ovh3 = run_resnet50(
+                    jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP,
+                    bf16=True)
+                out["bf16_batch%d_images_per_sec" % BATCH2] = round(
+                    img_s3, 2)
+                out["bf16_batch%d_step_ms" % BATCH2] = round(step_ms3, 2)
+                out.update(mfu_fields(
+                    "bf16_batch%d_" % BATCH2, step_ms3, flops3, peak))
+                out.update(_device_est("bf16_batch%d_" % BATCH2,
+                                       step_ms3, flops3, ovh3))
+            except Exception as e:
+                log("bf16 run failed: %s" % e)
+                out["bf16_error"] = str(e)[:200]
         # K-step-scan row: one dispatch per K steps, so the wall-clock
         # rate IS device throughput (no tunnel-latency subtraction).
         scan_k = int(os.environ.get("BENCH_SCAN_K", "8"))
-        if scan_k > 1:
+        if scan_k > 1 and not over_deadline(
+                out, "bf16_batch%d_scan" % BATCH2):
             try:
                 img_s5, step_ms5, _, _ = run_resnet50(
                     jax, jnp, BATCH2, 3, 1, bf16=True, scan_k=scan_k)
@@ -808,7 +835,9 @@ def main():
         # batch-512 bf16 scan row: the largest-batch device-rate point
         # (HBM-permitting; reported as an error field if it OOMs)
         b3 = int(os.environ.get("BENCH_BATCH3", "512"))
-        if b3 > BATCH2 and scan_k > 1:  # same knob gates every scan row
+        if (b3 > BATCH2 and scan_k > 1
+                and not over_deadline(out, "bf16_batch%d" % b3)):
+            # same knob gates every scan row
             try:
                 img_s7, step_ms7, _, _ = run_resnet50(
                     jax, jnp, b3, 2, 1, bf16=True, scan_k=scan_k)
@@ -825,6 +854,9 @@ def main():
                 out["batch%d_error" % b3] = str(e)[:200]
         # END-TO-END row: real .rec input through native decode into the
         # same fused step (every other row is synthetic-fed)
+        if over_deadline(out, "with_real_input"):
+            emit(out)
+            return
         try:
             img_s6, step_ms6, dec_img_s = run_resnet50_real_input(
                 jax, jnp, BATCH2, max(STEPS // 2, 5), 2, bf16=True)

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Round-4 hardware experiment sequence. Run when tools/tpu_health.py
+# reports healthy (docs/TPU_OPERATIONS.md). ONE claimant at a time:
+# each stage is a single python process, run serially, health-gated.
+#
+#   nohup bash tools/r4_hardware_run.sh > /tmp/r4_hw.log 2>&1 &
+#
+# Stages (order = value-per-minute if the tunnel wedges mid-sequence):
+#  1. bench.py                     -> driver-shaped baseline row set
+#  2. conv_bwd_experiments.py      -> A/B the two levers at step level
+#  3. conv_bwd_probe.py (TOP=8)    -> per-shape fwd/dgrad/wgrad attribution
+#  4. mirror_inception.py          -> remat-policy sweep
+#  5. benchmark_score.py           -> inference rows
+#  6. input-fed bench re-run with the winning lever flags (manual:
+#     inspect 2's output first)
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%m%d_%H%M)
+RES=benchmarks/results
+
+health() {
+  python tools/tpu_health.py --timeout 120 --json
+  return $?
+}
+
+stage() {  # stage <name> <cmd...>
+  local name=$1; shift
+  echo "=== [$(date +%H:%M:%S)] health-gate before $name ==="
+  if ! health; then
+    echo "=== tunnel unhealthy; stopping before $name ==="
+    exit 4
+  fi
+  echo "=== [$(date +%H:%M:%S)] $name: $* ==="
+  "$@" 2>&1 | tail -40
+  echo "=== [$(date +%H:%M:%S)] $name done (rc=${PIPESTATUS[0]}) ==="
+}
+
+stage_json() {  # stage_json <name> <outfile> <cmd...>  (stdout -> file)
+  local name=$1 outfile=$2; shift 2
+  echo "=== [$(date +%H:%M:%S)] health-gate before $name ==="
+  if ! health; then
+    echo "=== tunnel unhealthy; stopping before $name ==="
+    exit 4
+  fi
+  echo "=== [$(date +%H:%M:%S)] $name: $* -> $outfile ==="
+  "$@" > "$outfile" 2> >(tail -40 >&2)
+  echo "=== [$(date +%H:%M:%S)] $name done (rc=$?) ==="
+}
+
+stage_json bench_baseline "$RES/bench_r4_${STAMP}.json" \
+  env BENCH_DEADLINE=1500 python bench.py
+
+stage conv_experiments env EXP_TAG="v5e_${STAMP}" \
+  python benchmarks/conv_bwd_experiments.py
+
+stage conv_probe env PROBE_TOP=8 PROBE_TAG="v5e_${STAMP}" \
+  python benchmarks/conv_bwd_probe.py
+
+stage_json mirror_sweep "$RES/mirror_sweep_${STAMP}.json" \
+  python benchmarks/mirror_inception.py 128
+
+stage score env SCORE_TAG="v5e_${STAMP}" \
+  python benchmarks/benchmark_score.py
+
+echo "=== all stages done; inspect $RES/*_${STAMP}* and pick lever flags ==="

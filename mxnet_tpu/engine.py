@@ -18,8 +18,12 @@ module provides one with the reference's interface:
 """
 from __future__ import annotations
 
+import heapq
 import os
+import sys
 import threading
+import time
+import traceback
 from collections import deque
 
 from .base import MXNetError, get_env
@@ -37,6 +41,7 @@ class Var:
         self._pending_write = False
         self._num_pending_reads = 0
         self._last_opr = None  # most recently PUSHED op touching this var
+
 
 class _OprBlock:
     __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "done", "lock",
@@ -153,16 +158,12 @@ class ThreadedEngine:
                 )
 
     def _dispatch(self, opr):
-        import heapq
-
         with self._ready_cv:
             heapq.heappush(self._ready, (-opr.priority, self._seq, opr))
             self._seq += 1
             self._ready_cv.notify()
 
     def _worker(self):
-        import heapq
-
         while True:
             with self._ready_cv:
                 while not self._ready:
@@ -171,11 +172,7 @@ class ThreadedEngine:
             self._execute(opr)
 
     def _execute(self, opr):
-        import sys
-        import time as _time
-        import traceback
-
-        t0 = _time.monotonic()
+        t0 = time.monotonic()
         try:
             opr.fn()
         except BaseException as e:  # noqa: BLE001 — worker must survive
@@ -189,7 +186,7 @@ class ThreadedEngine:
             if trace is not None:
                 trace.append({
                     "name": opr.name, "priority": opr.priority,
-                    "start": t0, "end": _time.monotonic(),
+                    "start": t0, "end": time.monotonic(),
                     "thread": threading.current_thread().name,
                 })
             self._on_complete(opr)

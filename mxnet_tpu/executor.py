@@ -94,7 +94,12 @@ def _mirror_policy(prim, *_args, **_params):
     Read per call (trace-time only) so a sweep can change it between
     compiles without cache invalidation."""
     names = os.environ.get("MXNET_MIRROR_SAVE", _MIRROR_SAVE_DEFAULT)
-    return prim.name in {n.strip() for n in names.split(",") if n.strip()}
+    return prim.name in _mirror_save_set(names)
+
+
+@functools.lru_cache(maxsize=8)
+def _mirror_save_set(names):
+    return frozenset(n.strip() for n in names.split(",") if n.strip())
 
 
 def _node_attrs(program, node, rng):
@@ -626,6 +631,16 @@ class Executor:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def _drain_pending_pulls(self):
+        """kvstore-managed weights may have an engine-scheduled pull
+        still in flight (the executor-path overlap this framework
+        preserves from the reference's prioritized comm engine); drain
+        before snapshotting ._data. The inline attr check keeps the
+        common no-kvstore case to one comparison per array."""
+        for a in self.arg_arrays:
+            if a._engine_dep is not None:
+                a._drain_engine()
+
     def forward(self, is_train=False, **kwargs):
         """Parity: Executor::Forward. For a training step the launch is
         deferred so backward() can run forward+backward as ONE fused XLA
@@ -645,12 +660,7 @@ class Executor:
                 else:
                     arg_dict[k]._data = nd.array(v)._data
         rng = _random.next_key() if self._needs_rng else None
-        # kvstore-managed weights may have an engine-scheduled pull still
-        # in flight (the overlap this framework preserves from the
-        # reference's prioritized comm engine); drain before snapshotting
-        for a in self.arg_arrays:
-            if a._engine_dep is not None:
-                a._drain_engine()
+        self._drain_pending_pulls()
         arg_vals = tuple(a._data for a in self.arg_arrays)
         aux_vals = tuple(a._data for a in self.aux_arrays)
         self._stash = (arg_vals, aux_vals, rng, bool(is_train))
@@ -705,11 +715,9 @@ class Executor:
         if self._stash is not None:
             arg_vals, aux_vals, rng, _ = self._stash
         else:
-            # same in-flight-pull drain as forward(): backward without a
-            # prior forward must not snapshot stale weights
-            for a in self.arg_arrays:
-                if a._engine_dep is not None:
-                    a._drain_engine()
+            # backward without a prior forward must not snapshot stale
+            # weights either
+            self._drain_pending_pulls()
             arg_vals = tuple(a._data for a in self.arg_arrays)
             aux_vals = tuple(a._data for a in self.aux_arrays)
             rng = _random.next_key() if self._needs_rng else None

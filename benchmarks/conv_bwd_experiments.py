@@ -7,14 +7,17 @@ with all rows — one process, one tunnel claim, no subprocess sweeps
 (XLA_FLAGS-style sweeps need a fresh process per config, which multiplies
 claim cycles; the in-process env knobs below don't).
 
-Candidates (4 rows, one fresh compile each — budget tunnel time
+Candidates (5 rows, one fresh compile each — budget tunnel time
 accordingly):
   baseline            current default
   conv_bwd_nhwc       MXNET_CONV_BWD_LAYOUT=NHWC (backward convs in
                       explicit NHWC, ops/nn.py _conv2d_bwd_nhwc)
   stem_s2d            BENCH_STEM_S2D=1 (exact-equivalent space-to-depth
                       stem, models/resnet.py stem_s2d)
-  nhwc+s2d            both levers together
+  s2d_strided         + MXNET_CONV_S2D=1 (EVERY stride-2 conv lowered to
+                      s2d space: dgrad loses its zero-stuffed
+                      lhs-dilation, ops/nn.py _conv2d_s2d_strided)
+  nhwc+s2d_strided    all levers together
 
 Run: python benchmarks/conv_bwd_experiments.py
 """
@@ -67,7 +70,8 @@ def main():
     import jax.numpy as jnp
 
     dev = jax.devices()[0]
-    off = {"MXNET_CONV_BWD_LAYOUT": None, "BENCH_STEM_S2D": None}
+    off = {"MXNET_CONV_BWD_LAYOUT": None, "BENCH_STEM_S2D": None,
+           "MXNET_CONV_S2D": None}
     rows = [
         # explicit None: a flag inherited from the caller's shell must
         # not silently turn the baseline row into a lever row
@@ -75,8 +79,11 @@ def main():
         measure(jax, jnp, "conv_bwd_nhwc",
                 {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC"}),
         measure(jax, jnp, "stem_s2d", {**off, "BENCH_STEM_S2D": "1"}),
-        measure(jax, jnp, "nhwc+s2d",
-                {"MXNET_CONV_BWD_LAYOUT": "NHWC", "BENCH_STEM_S2D": "1"}),
+        measure(jax, jnp, "s2d_strided",
+                {**off, "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
+        measure(jax, jnp, "nhwc+s2d_strided",
+                {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC",
+                 "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
     ]
     for r in rows:
         print(json.dumps(r), file=sys.stderr)

@@ -263,12 +263,90 @@ def _conv2d_bwd_nhwc(data, weight, stride, pad, dilate, groups):
     return conv(data, weight)
 
 
+def _conv2d_s2d_strided(data, weight, kernel, pad, groups):
+    """Stride-2 2-D conv computed in 2x2 space-to-depth space — exact,
+    and the gradient convs become STRIDE-1 (no lhs-dilated dgrad, which
+    wastes 3/4 of its MACs multiplying stuffed zeros; the generalization
+    of the MLPerf stem trick to every stride-2 conv, same tap algebra as
+    models/resnet.convert_stem_to_s2d).
+
+    Per spatial dim (stride 2, kernel k, pad p): input index
+    m = 2i + q - p maps tap q to (u, dm) with q = 2(u) + dm + p shifted
+    so u ranges [u_min, u_max]; the s2d conv has kernel
+    K = u_max - u_min + 1, asymmetric pad (-u_min, u_max), and weight
+    w_s2d[o, (c,dh,dw), U, V] = w[o, c, 2(U+u_min_h)+dh+p_h, ...]
+    (zero outside [0, k)). Autodiff differentiates straight through the
+    reshapes + stride-1 conv, so no custom_vjp is needed.
+
+    Gated by MXNET_CONV_S2D=1 (only stride (2,2), dilate 1, even
+    spatial, and kernel in {2*pad+1, 2*pad+2} per dim — the s2d form
+    always emits H/2 outputs, which equals the strided conv's count
+    only for those 'same'-family shapes; the _convolution gate
+    enforces this); numerics pinned in
+    tests/test_conv_bwd_layout.py."""
+    n, c, h, w = data.shape
+    o, cg, kh, kw = weight.shape
+    assert all(k in (2 * p + 1, 2 * p + 2)
+               for k, p in zip(kernel, pad)), (kernel, pad)
+
+    def dim_map(k, p):
+        u_min = (0 - p - ((0 - p) % 2)) // 2
+        u_max = (k - 1 - p - ((k - 1 - p) % 2)) // 2
+        return u_min, u_max
+
+    uh0, uh1 = dim_map(kh, pad[0])
+    uw0, uw1 = dim_map(kw, pad[1])
+    K_h, K_w = uh1 - uh0 + 1, uw1 - uw0 + 1
+
+    # s2d input: (N, C, H, W) -> (N, C*4, H/2, W/2), channels (c, dh, dw)
+    xs = data.reshape(n, c, h // 2, 2, w // 2, 2)
+    xs = jnp.transpose(xs, (0, 1, 3, 5, 2, 4)).reshape(
+        n, c * 4, h // 2, w // 2)
+
+    # s2d weight, built by gathering taps (zero outside the kernel):
+    # embed w into a zero canvas indexed by q = 2(U+u_min)+dm+p
+    qh = 2 * (jnp.arange(K_h)[:, None] + uh0) + jnp.arange(2)[None, :] \
+        + pad[0]  # (K_h, dh)
+    qw = 2 * (jnp.arange(K_w)[:, None] + uw0) + jnp.arange(2)[None, :] \
+        + pad[1]  # (K_w, dw)
+    # gather with clamping + mask (jnp.take clamps; mask zeroes OOB taps)
+    wh_idx = jnp.clip(qh, 0, kh - 1)
+    ww_idx = jnp.clip(qw, 0, kw - 1)
+    mask_h = ((qh >= 0) & (qh < kh)).astype(weight.dtype)
+    mask_w = ((qw >= 0) & (qw < kw)).astype(weight.dtype)
+    # w: (O, C/g, kh, kw) -> (O, C/g, K_h, dh, K_w, dw)
+    wg = jnp.take(weight, wh_idx.reshape(-1), axis=2).reshape(
+        o, cg, K_h, 2, kw)
+    wg = jnp.take(wg, ww_idx.reshape(-1), axis=4).reshape(
+        o, cg, K_h, 2, K_w, 2)
+    wg = wg * mask_h[None, None, :, :, None, None] \
+            * mask_w[None, None, None, None, :, :]
+    # -> (O, (c, dh, dw), K_h, K_w) matching the input channel order
+    ws = jnp.transpose(wg, (0, 1, 3, 5, 2, 4)).reshape(
+        o, cg * 4, K_h, K_w)
+
+    return jax.lax.conv_general_dilated(
+        xs, ws, window_strides=(1, 1),
+        padding=[(-uh0, uh1), (-uw0, uw1)],
+        dimension_numbers=_conv_dn(2), feature_group_count=groups)
+
+
 def _convolution(attrs, ins, is_train):
     kernel, stride, dilate, pad = _conv_dims(attrs)
     nd = len(kernel)
     groups = int(attrs.get("num_group", 1))
     data, weight = ins[0], ins[1]
-    if nd == 2 and os.environ.get("MXNET_CONV_BWD_LAYOUT") == "NHWC":
+    if (nd == 2 and os.environ.get("MXNET_CONV_S2D") == "1"
+            and tuple(stride) == (2, 2) and tuple(dilate) == (1, 1)
+            and data.shape[2] % 2 == 0 and data.shape[3] % 2 == 0
+            # the s2d form emits exactly H/2 outputs per dim, which
+            # matches the strided conv only for 'same'-family shapes
+            # (k == 2p+1 or 2p+2); others (e.g. 3x3/s2/p0 inception
+            # reductions) fall back to the default lowering
+            and all(k in (2 * p + 1, 2 * p + 2)
+                    for k, p in zip(kernel, pad))):
+        out = _conv2d_s2d_strided(data, weight, kernel, pad, groups)
+    elif nd == 2 and os.environ.get("MXNET_CONV_BWD_LAYOUT") == "NHWC":
         out = _conv2d_bwd_nhwc(data, weight, stride, pad, dilate, groups)
     else:
         # NOTE: no preferred_element_type here — the MXU accumulates bf16

@@ -54,6 +54,99 @@ def test_nhwc_backward_matches_default(dshape, wshape, stride, pad,
                                rtol=1e-4, atol=1e-4)
 
 
+S2D_CASES = [
+    ((2, 8, 56, 56), (16, 8, 1, 1), (0, 0), 1),   # 1x1 s2 projection
+    ((2, 8, 56, 56), (16, 8, 3, 3), (1, 1), 1),   # 3x3 s2
+    ((2, 3, 224, 224), (8, 3, 7, 7), (3, 3), 1),  # stem
+    ((2, 8, 28, 28), (8, 4, 3, 3), (1, 1), 2),    # grouped 3x3 s2
+    ((2, 4, 14, 14), (6, 4, 5, 5), (2, 2), 1),    # 5x5 s2
+]
+
+
+@pytest.mark.parametrize("dshape,wshape,pad,groups", S2D_CASES)
+def test_s2d_strided_matches_default(dshape, wshape, pad, groups):
+    """MXNET_CONV_S2D lever (ops/nn.py _conv2d_s2d_strided): the
+    space-to-depth lowering of stride-2 convs — which turns the
+    zero-stuffed lhs-dilated dgrad into plain stride-1 convs — must be
+    exact in forward AND both gradients for every stride-2 shape class
+    ResNet uses (projection 1x1, 3x3, the stem 7x7, grouped, 5x5)."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*dshape), jnp.float32)
+    w = jnp.asarray(rng.randn(*wshape), jnp.float32)
+
+    def f_default(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(2, 2), padding=[(p, p) for p in pad],
+            dimension_numbers=nn._conv_dn(2), feature_group_count=groups)
+
+    y0, vjp0 = jax.vjp(f_default, x, w)
+    ct = jnp.asarray(rng.randn(*y0.shape), jnp.float32)
+    gx0, gw0 = vjp0(ct)
+    kernel = wshape[2:]
+    y1, vjp1 = jax.vjp(
+        lambda x, w: nn._conv2d_s2d_strided(x, w, kernel, pad, groups),
+        x, w)
+    gx1, gw1 = vjp1(ct)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx0), np.asarray(gx1),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw0), np.asarray(gw1),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_s2d_env_flag_routes_training_grads(monkeypatch):
+    """Product path: executor grads with MXNET_CONV_S2D on == off."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), stride=(2, 2), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 5, 16, 16).astype(np.float32)
+    lab = rng.randint(0, 3, 4).astype(np.float32)
+
+    def grads(flag):
+        if flag:
+            monkeypatch.setenv("MXNET_CONV_S2D", "1")
+        else:
+            monkeypatch.delenv("MXNET_CONV_S2D", raising=False)
+        exe = net.simple_bind(ctx=mx.cpu(), data=(4, 5, 16, 16),
+                              softmax_label=(4,))
+        r = np.random.RandomState(7)
+        for n, a in sorted(exe.arg_dict.items()):
+            if n in ("data", "softmax_label"):
+                continue
+            a[:] = r.randn(*a.shape).astype(np.float32) * 0.1
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["softmax_label"][:] = lab
+        exe.forward(is_train=True)
+        exe.backward()
+        return {n: g.asnumpy() for n, g in exe.grad_dict.items()
+                if g is not None}
+
+    g_off = grads(False)
+    g_on = grads(True)
+    for n in g_off:
+        np.testing.assert_allclose(g_off[n], g_on[n], rtol=1e-4,
+                                   atol=1e-5, err_msg=n)
+
+
+def test_s2d_gate_skips_non_same_pads(monkeypatch):
+    """A 3x3/s2/pad-0 conv (inception-reduction shape) emits
+    floor((H-3)/2)+1 outputs — NOT H/2 — so the s2d gate must route it
+    to the default lowering (the s2d form would emit the wrong count)."""
+    monkeypatch.setenv("MXNET_CONV_S2D", "1")
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(1, 4, 8, 8).astype(np.float32))
+    w = mx.nd.array(rng.randn(4, 4, 3, 3).astype(np.float32))
+    y = mx.nd.Convolution(x, w, kernel=(3, 3), stride=(2, 2),
+                          pad=(0, 0), num_filter=4, no_bias=True)
+    assert y.shape == (1, 4, 3, 3), y.shape
+
+
 def test_env_flag_routes_training_grads(monkeypatch):
     """Full product path: executor grads with the flag on == off."""
     data = mx.sym.Variable("data")

@@ -339,6 +339,20 @@ def _convolution(attrs, ins, is_train):
     if (nd == 2 and os.environ.get("MXNET_CONV_S2D") == "1"
             and tuple(stride) == (2, 2) and tuple(dilate) == (1, 1)
             and data.shape[2] % 2 == 0 and data.shape[3] % 2 == 0
+            and tuple(kernel) == (1, 1) and tuple(pad) == (0, 0)):
+        # 1x1/s2: strided SLICE + dense 1x1 conv. The s2d canvas form
+        # would 4x the dense MACs (masked zero channels are traced
+        # values XLA can't prune); slicing keeps fwd/wgrad dense-sized
+        # and the dgrad becomes slice-transpose (a cheap zero-pad
+        # scatter) instead of an lhs-dilated conv.
+        out = jax.lax.conv_general_dilated(
+            data[:, :, ::2, ::2], weight, window_strides=(1, 1),
+            padding=[(0, 0), (0, 0)], dimension_numbers=_conv_dn(2),
+            feature_group_count=groups)
+    elif (nd == 2 and os.environ.get("MXNET_CONV_S2D") == "1"
+            and tuple(stride) == (2, 2) and tuple(dilate) == (1, 1)
+            and data.shape[2] % 2 == 0 and data.shape[3] % 2 == 0
+            and max(kernel) > 1
             # the s2d form emits exactly H/2 outputs per dim, which
             # matches the strided conv only for 'same'-family shapes
             # (k == 2p+1 or 2p+2); others (e.g. 3x3/s2/p0 inception

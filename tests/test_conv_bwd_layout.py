@@ -55,11 +55,12 @@ def test_nhwc_backward_matches_default(dshape, wshape, stride, pad,
 
 
 S2D_CASES = [
-    ((2, 8, 56, 56), (16, 8, 1, 1), (0, 0), 1),   # 1x1 s2 projection
     ((2, 8, 56, 56), (16, 8, 3, 3), (1, 1), 1),   # 3x3 s2
     ((2, 3, 224, 224), (8, 3, 7, 7), (3, 3), 1),  # stem
     ((2, 8, 28, 28), (8, 4, 3, 3), (1, 1), 2),    # grouped 3x3 s2
     ((2, 4, 14, 14), (6, 4, 5, 5), (2, 2), 1),    # 5x5 s2
+    ((2, 4, 16, 16), (6, 4, 2, 2), (0, 0), 1),    # even kernel k=2p+2
+    ((2, 4, 16, 16), (6, 4, 4, 4), (1, 1), 1),    # even kernel k=2p+2
 ]
 
 
@@ -132,6 +133,46 @@ def test_s2d_env_flag_routes_training_grads(monkeypatch):
     for n in g_off:
         np.testing.assert_allclose(g_off[n], g_on[n], rtol=1e-4,
                                    atol=1e-5, err_msg=n)
+
+
+def test_s2d_1x1_slice_path(monkeypatch):
+    """1x1/s2 routes to the strided-slice lowering (NOT the s2d canvas,
+    which would 4x its dense MACs); outputs and training grads must
+    match the default path."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 16, 16).astype(np.float32)
+    lab = rng.randint(0, 3, 2).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(1, 1), num_filter=8,
+                             stride=(2, 2), no_bias=True, name="c1")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def run(flag):
+        if flag:
+            monkeypatch.setenv("MXNET_CONV_S2D", "1")
+        else:
+            monkeypatch.delenv("MXNET_CONV_S2D", raising=False)
+        exe = net.simple_bind(ctx=mx.cpu(), data=(2, 6, 16, 16),
+                              softmax_label=(2,))
+        r = np.random.RandomState(3)
+        for n, a in sorted(exe.arg_dict.items()):
+            if n not in ("data", "softmax_label"):
+                a[:] = r.randn(*a.shape).astype(np.float32) * 0.1
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["softmax_label"][:] = lab
+        out = exe.forward(is_train=True)[0].asnumpy()
+        exe.backward()
+        return out, {n: g.asnumpy() for n, g in exe.grad_dict.items()
+                     if g is not None}
+
+    y0, g0 = run(False)
+    y1, g1 = run(True)
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-6)
+    for n in g0:
+        np.testing.assert_allclose(g0[n], g1[n], rtol=1e-4, atol=1e-5,
+                                   err_msg=n)
 
 
 def test_s2d_gate_skips_non_same_pads(monkeypatch):

@@ -62,4 +62,7 @@ stage_json mirror_sweep "$RES/mirror_sweep_${STAMP}.json" \
 stage score env SCORE_TAG="v5e_${STAMP}" \
   python benchmarks/benchmark_score.py
 
+stage transformer env TLM_TAG="v5e_${STAMP}" \
+  python benchmarks/transformer_bench.py
+
 echo "=== all stages done; inspect $RES/*_${STAMP}* and pick lever flags ==="

@@ -72,19 +72,19 @@ def main():
     dev = jax.devices()[0]
     off = {"MXNET_CONV_BWD_LAYOUT": None, "BENCH_STEM_S2D": None,
            "MXNET_CONV_S2D": None}
-    rows = [
+    candidates = [
         # explicit None: a flag inherited from the caller's shell must
         # not silently turn the baseline row into a lever row
-        measure(jax, jnp, "baseline", dict(off)),
-        measure(jax, jnp, "conv_bwd_nhwc",
-                {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC"}),
-        measure(jax, jnp, "stem_s2d", {**off, "BENCH_STEM_S2D": "1"}),
-        measure(jax, jnp, "s2d_strided",
-                {**off, "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
-        measure(jax, jnp, "nhwc+s2d_strided",
-                {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC",
-                 "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
+        ("baseline", dict(off)),
+        ("conv_bwd_nhwc", {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC"}),
+        ("stem_s2d", {**off, "BENCH_STEM_S2D": "1"}),
+        ("s2d_strided",
+         {**off, "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
+        ("nhwc+s2d_strided",
+         {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC",
+          "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
     ]
+    rows = [measure(jax, jnp, tag, env) for tag, env in candidates]
     for r in rows:
         print(json.dumps(r), file=sys.stderr)
     out = {"batch": BATCH, "scan_k": SCAN_K,
@@ -92,10 +92,51 @@ def main():
            "device_kind": getattr(dev, "device_kind", "?"),
            "rows": rows}
     tag = os.environ.get("EXP_TAG", "v5e_r4")
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "results", "conv_bwd_experiments_%s.json" % tag)
+    res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    path = os.path.join(res_dir, "conv_bwd_experiments_%s.json" % tag)
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
+
+    # Autotune cache (the reference's cudnn_tune idea, whole-step
+    # flavor): record the winning lever set when it beats baseline by
+    # >3% on REAL hardware; bench.py applies it by default
+    # (BENCH_AUTOTUNE=0 disables) and stamps it in its output. Only a
+    # real-accelerator measurement may write the cache.
+    if dev.platform in ("tpu", "axon"):
+        ok = [(r, env) for r, (t, env) in zip(rows, candidates)
+              if "images_per_sec" in r]
+        base = next((r for r, _ in ok if r["tag"] == "baseline"), None)
+        if base and len(ok) > 1:
+            best, best_env = max(ok, key=lambda p: p[0]["images_per_sec"])
+            cache = {
+                "measured_on": out["device_kind"],
+                # regime: bench.py only applies the cache to rows in
+                # the same configuration it was measured under
+                "regime": {"dtype": "bf16", "batch": BATCH,
+                           "scan_k": SCAN_K},
+                "source": os.path.basename(path),
+            }
+            if (best["tag"] != "baseline"
+                    and best["images_per_sec"]
+                    > 1.03 * base["images_per_sec"]):
+                cache.update({
+                    "best": best["tag"],
+                    "env": {k: v for k, v in best_env.items()
+                            if v is not None},
+                    "gain_vs_baseline": round(
+                        best["images_per_sec"]
+                        / base["images_per_sec"], 3),
+                })
+            else:
+                # explicit no-winner record OVERWRITES any stale cache
+                # so bench.py never keeps applying a lever the latest
+                # hardware sweep failed to confirm
+                cache.update({"best": "baseline", "env": {}})
+            with open(os.path.join(res_dir, "levers_v5e.json"),
+                      "w") as f:
+                json.dump(cache, f, indent=1)
+            print(json.dumps({"levers_cache": cache}), file=sys.stderr)
     print(json.dumps({"written": path, "rows": rows}))
 
 

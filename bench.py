@@ -349,10 +349,19 @@ def init_backend():
         "+".join(str(s) for s in INIT_SCHEDULE)), True
 
 
+_BUILD_MEMO = {}  # (batch, bf16, scan_k, lever env) -> (run, flops)
+
+
 def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
     """Shared builder for the synthetic and real-input rows: returns
     (run, params, moms, aux, flops_per_step) with `run` the compiled
     (or first-call-jitted) fused train step.
+
+    The compiled executable is memoized per (batch, bf16, scan_k,
+    lever-env) — through a wedge-prone remote tunnel every saved
+    compile is a minute of claim time — while params/moms/aux are
+    always rebuilt fresh (the executable donates its state arguments,
+    so buffers must never be shared across rows).
 
     bf16=True runs the reference's reduced-precision recipe
     (example/image-classification/symbols/resnet_fp16.py: fp16 compute,
@@ -368,7 +377,6 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
     # C=3 7x7/s2 stem conv
     sym = get_symbol(num_classes=1000, num_layers=50,
                      stem_s2d=os.environ.get("BENCH_STEM_S2D") == "1")
-    program = _GraphProgram(sym)
     data_shape = (batch, 3, 224, 224)
     arg_shapes, _, aux_shapes = sym.infer_shape(
         data=data_shape, softmax_label=(batch,)
@@ -394,6 +402,25 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
     }
     moms = {n: np.zeros_like(v) for n, v in params.items()}
 
+    memo_key = (batch, bf16, scan_k,
+                os.environ.get("BENCH_STEM_S2D"),
+                os.environ.get("MXNET_CONV_S2D"),
+                os.environ.get("MXNET_CONV_BWD_LAYOUT"),
+                os.environ.get("MXNET_MIRROR_SAVE"),
+                os.environ.get("MXNET_BACKWARD_DO_MIRROR"))
+
+    def _fresh_state():
+        return ({k: jnp.asarray(v) for k, v in params.items()},
+                {k: jnp.asarray(v) for k, v in moms.items()},
+                {k: jnp.asarray(v) for k, v in aux.items()})
+
+    if memo_key in _BUILD_MEMO:
+        run, flops_per_step = _BUILD_MEMO[memo_key]
+        log("compile-b%d: memo hit (no recompile)" % batch)
+        p, m, a = _fresh_state()
+        return run, p, m, a, flops_per_step, data_shape
+
+    program = _GraphProgram(sym)
     lr, momentum, wd = 0.1, 0.9, 1e-4
     rescale = 1.0 / batch
 
@@ -429,9 +456,7 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
     else:
         step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
-    params = {k: jnp.asarray(v) for k, v in params.items()}
-    moms = {k: jnp.asarray(v) for k, v in moms.items()}
-    aux = {k: jnp.asarray(v) for k, v in aux.items()}
+    params, moms, aux = _fresh_state()
 
     stage("compile-b%d" % batch)
     t0 = time.perf_counter()
@@ -453,6 +478,9 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
         except Exception as e:
             log("cost_analysis unavailable: %s" % e)
         log("compiled in %.1fs" % (time.perf_counter() - t0))
+        # memoize ONLY the success path: a transient compile failure
+        # must not poison later rows out of their retry
+        _BUILD_MEMO[memo_key] = (run, flops_per_step)
     except Exception as e:
         # lower/compile path failed; fall back to tracing via first call
         log("explicit compile failed (%s); relying on first-call jit" % e)

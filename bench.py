@@ -349,10 +349,11 @@ def init_backend():
         "+".join(str(s) for s in INIT_SCHEDULE)), True
 
 
-_BUILD_MEMO = {}  # (batch, bf16, scan_k, lever env) -> (run, flops)
+_BUILD_MEMO = {}  # (batch, bf16, scan_k, copts, lever env) -> (run, flops)
 
 
-def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
+def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0,
+                         compiler_options=None):
     """Shared builder for the synthetic and real-input rows: returns
     (run, params, moms, aux, flops_per_step) with `run` the compiled
     (or first-call-jitted) fused train step.
@@ -403,6 +404,7 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
     moms = {n: np.zeros_like(v) for n, v in params.items()}
 
     memo_key = (batch, bf16, scan_k,
+                tuple(sorted((compiler_options or {}).items())),
                 os.environ.get("BENCH_STEM_S2D"),
                 os.environ.get("MXNET_CONV_S2D"),
                 os.environ.get("MXNET_CONV_BWD_LAYOUT"),
@@ -467,8 +469,11 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
         # AOT-compile once and run THROUGH the compiled executable (a
         # separate step() call would miss jit's dispatch cache and compile
         # the whole fwd+bwd graph a second time).
-        compiled = step.lower(
-            params, moms, aux, spec_data, spec_label).compile()
+        lowered = step.lower(params, moms, aux, spec_data, spec_label)
+        # per-compile XLA knobs (conv_bwd_experiments sweeps these
+        # in-process — unlike XLA_FLAGS, no fresh-process claim cycle)
+        compiled = (lowered.compile(compiler_options=compiler_options)
+                    if compiler_options else lowered.compile())
         run = compiled
         try:
             ca = compiled.cost_analysis()
@@ -482,13 +487,19 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0):
         # must not poison later rows out of their retry
         _BUILD_MEMO[memo_key] = (run, flops_per_step)
     except Exception as e:
+        if compiler_options:
+            # a rejected option must FAIL the row — the first-call-jit
+            # fallback would silently measure the default config under
+            # the option row's label
+            raise
         # lower/compile path failed; fall back to tracing via first call
         log("explicit compile failed (%s); relying on first-call jit" % e)
         run = step
     return run, params, moms, aux, flops_per_step, data_shape
 
 
-def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False, scan_k=0):
+def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False, scan_k=0,
+                 compiler_options=None):
     """Synthetic-fed training row; returns (img_s, step_ms, flops, ovh).
 
     scan_k > 1 fuses K consecutive training steps into ONE dispatched
@@ -498,7 +509,8 @@ def run_resnet50(jax, jnp, batch, steps, warmup, bf16=False, scan_k=0):
     estimating it by subtraction. `steps` counts dispatches in this
     mode; reported step time is per inner step."""
     run, params, moms, aux, flops_per_step, data_shape = (
-        _build_resnet50_step(jax, jnp, batch, bf16=bf16, scan_k=scan_k))
+        _build_resnet50_step(jax, jnp, batch, bf16=bf16, scan_k=scan_k,
+                             compiler_options=compiler_options))
     rng = np.random.RandomState(1)
     data = jnp.asarray(rng.rand(*data_shape), jnp.float32)
     label = jnp.asarray(rng.randint(0, 1000, batch), jnp.float32)

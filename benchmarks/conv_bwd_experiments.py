@@ -7,8 +7,8 @@ with all rows — one process, one tunnel claim, no subprocess sweeps
 (XLA_FLAGS-style sweeps need a fresh process per config, which multiplies
 claim cycles; the in-process env knobs below don't).
 
-Candidates (5 rows, one fresh compile each — budget tunnel time
-accordingly):
+Candidates (7 rows — 5 lever rows + 2 compiler-option probes — one
+fresh compile each; budget tunnel time accordingly):
   baseline            current default
   conv_bwd_nhwc       MXNET_CONV_BWD_LAYOUT=NHWC (backward convs in
                       explicit NHWC, ops/nn.py _conv2d_bwd_nhwc)
@@ -35,7 +35,7 @@ SCAN_K = int(os.environ.get("EXP_SCAN_K", "8"))
 DISPATCHES = int(os.environ.get("EXP_DISPATCHES", "3"))
 
 
-def measure(jax, jnp, tag, env):
+def measure(jax, jnp, tag, env, compiler_options=None):
     import bench
 
     saved = {}
@@ -48,7 +48,8 @@ def measure(jax, jnp, tag, env):
     try:
         t0 = time.perf_counter()
         img_s, step_ms, _, _ = bench.run_resnet50(
-            jax, jnp, BATCH, DISPATCHES, 1, bf16=True, scan_k=SCAN_K)
+            jax, jnp, BATCH, DISPATCHES, 1, bf16=True, scan_k=SCAN_K,
+            compiler_options=compiler_options)
         return {"tag": tag, "images_per_sec": round(img_s, 2),
                 "step_ms": round(step_ms, 2),
                 "wall_s": round(time.perf_counter() - t0, 1)}
@@ -85,6 +86,17 @@ def main():
           "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
     ]
     rows = [measure(jax, jnp, tag, env) for tag, env in candidates]
+    # Compiler-option probes (in-process per-compile XLA knobs; an
+    # unsupported flag just lands as an error row). These explore
+    # whether deeper fusion headroom moves the conv-heavy step; they
+    # do NOT participate in the lever cache (env-only levers do).
+    for tag, opts in (
+        ("xla_vmem_48m", {"xla_tpu_scoped_vmem_limit_kib": "49152"}),
+        ("xla_lhs_scheduler",
+         {"xla_tpu_enable_latency_hiding_scheduler": "true"}),
+    ):
+        rows.append(measure(jax, jnp, tag, dict(off),
+                            compiler_options=opts))
     for r in rows:
         print(json.dumps(r), file=sys.stderr)
     out = {"batch": BATCH, "scan_k": SCAN_K,
@@ -104,7 +116,8 @@ def main():
     # (BENCH_AUTOTUNE=0 disables) and stamps it in its output. Only a
     # real-accelerator measurement may write the cache.
     if dev.platform in ("tpu", "axon"):
-        ok = [(r, env) for r, (t, env) in zip(rows, candidates)
+        ok = [(r, env) for r, (t, env)
+              in zip(rows[:len(candidates)], candidates)  # env rows only
               if "images_per_sec" in r]
         base = next((r for r, _ in ok if r["tag"] == "baseline"), None)
         if base and len(ok) > 1:

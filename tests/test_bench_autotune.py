@@ -1,0 +1,85 @@
+"""bench.maybe_apply_levers: the autotune-cache application the driver's
+end-of-round TPU run depends on. Pins: regime gating (device_kind +
+bf16), explicit-env-wins with partial stamping, baseline-best records
+applying nothing, disable knob, and unreadable-cache resilience.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+CACHE = {
+    "best": "s2d_strided",
+    "env": {"MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"},
+    "gain_vs_baseline": 1.12,
+    "measured_on": "TPU v5 lite",
+    "regime": {"dtype": "bf16", "batch": 256, "scan_k": 8},
+    "source": "conv_bwd_experiments_test.json",
+}
+
+
+def _write(tmp_path, cache):
+    p = tmp_path / "levers.json"
+    p.write_text(json.dumps(cache))
+    return str(p)
+
+
+def test_applies_on_matching_regime(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_CONV_S2D", raising=False)
+    monkeypatch.delenv("BENCH_STEM_S2D", raising=False)
+    out = {}
+    bench.maybe_apply_levers(out, "TPU v5 lite", _write(tmp_path, CACHE))
+    assert os.environ.get("MXNET_CONV_S2D") == "1"
+    assert out["autotuned_levers"]["best"] == "s2d_strided"
+    assert out["autotuned_levers"]["gain_vs_baseline"] == 1.12
+    monkeypatch.delenv("MXNET_CONV_S2D", raising=False)
+    monkeypatch.delenv("BENCH_STEM_S2D", raising=False)
+
+
+def test_skips_on_device_kind_mismatch(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_CONV_S2D", raising=False)
+    monkeypatch.delenv("BENCH_STEM_S2D", raising=False)
+    out = {}
+    bench.maybe_apply_levers(out, "TPU v6 lite", _write(tmp_path, CACHE))
+    assert "MXNET_CONV_S2D" not in os.environ
+    assert "autotuned_levers" not in out
+
+
+def test_explicit_env_wins_and_partial_is_stamped(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_CONV_S2D", "0")  # operator's explicit pick
+    monkeypatch.delenv("BENCH_STEM_S2D", raising=False)
+    out = {}
+    bench.maybe_apply_levers(out, "TPU v5 lite", _write(tmp_path, CACHE))
+    assert os.environ["MXNET_CONV_S2D"] == "0"  # untouched
+    stamp = out["autotuned_levers"]
+    assert stamp["partial_overridden_by_env"] == {"MXNET_CONV_S2D": "0"}
+    assert "gain_vs_baseline" not in stamp  # gain doesn't describe hybrid
+    monkeypatch.delenv("BENCH_STEM_S2D", raising=False)
+
+
+def test_baseline_best_record_applies_nothing(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_CONV_S2D", raising=False)
+    cache = dict(CACHE, best="baseline", env={})
+    out = {}
+    bench.maybe_apply_levers(out, "TPU v5 lite", _write(tmp_path, cache))
+    assert "MXNET_CONV_S2D" not in os.environ
+    assert "autotuned_levers" not in out
+
+
+def test_disable_knob_and_bad_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_AUTOTUNE", "0")
+    out = {}
+    bench.maybe_apply_levers(out, "TPU v5 lite", _write(tmp_path, CACHE))
+    assert "autotuned_levers" not in out
+    monkeypatch.setenv("BENCH_AUTOTUNE", "1")
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    out = {}
+    bench.maybe_apply_levers(out, "TPU v5 lite", str(p))  # must not raise
+    assert "autotuned_levers" not in out
+    bench.maybe_apply_levers(out, "TPU v5 lite",
+                             str(tmp_path / "missing.json"))
+    assert "autotuned_levers" not in out

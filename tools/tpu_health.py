@@ -74,10 +74,12 @@ def probe(timeout_s):
         }
     for line in stdout.splitlines():
         if line.startswith("HEALTH_OK"):
-            _, platform, kind, init_s = line.split(None, 3)
+            # device_kind may itself contain spaces ("TPU v5 lite"), so
+            # the probe time is the LAST token, kind is everything between
+            parts = line.split()
             return "healthy", {
-                "platform": platform, "device_kind": kind,
-                "probe_s": float(init_s),
+                "platform": parts[1], "device_kind": " ".join(parts[2:-1]),
+                "probe_s": float(parts[-1]),
                 "elapsed_s": round(time.time() - t0, 1),
             }
     return "error", {

@@ -63,6 +63,31 @@ def measure(jax, jnp, tag, env, compiler_options=None):
                 os.environ[k] = v
 
 
+OFF = {"MXNET_CONV_BWD_LAYOUT": None, "BENCH_STEM_S2D": None,
+       "MXNET_CONV_S2D": None}
+# explicit None: a flag inherited from the caller's shell must
+# not silently turn the baseline row into a lever row
+CANDIDATES = [
+    ("baseline", dict(OFF)),
+    ("conv_bwd_nhwc", {**OFF, "MXNET_CONV_BWD_LAYOUT": "NHWC"}),
+    ("stem_s2d", {**OFF, "BENCH_STEM_S2D": "1"}),
+    ("s2d_strided",
+     {**OFF, "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
+    ("nhwc+s2d_strided",
+     {**OFF, "MXNET_CONV_BWD_LAYOUT": "NHWC",
+      "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
+]
+# Compiler-option probes (in-process per-compile XLA knobs; an
+# unsupported flag just lands as an error row). These explore
+# whether deeper fusion headroom moves the conv-heavy step; they
+# do NOT participate in the lever cache (env-only levers do).
+COMPILER_PROBES = [
+    ("xla_vmem_48m", {"xla_tpu_scoped_vmem_limit_kib": "49152"}),
+    ("xla_lhs_scheduler",
+     {"xla_tpu_enable_latency_hiding_scheduler": "true"}),
+]
+
+
 def main():
     import jax
 
@@ -71,42 +96,47 @@ def main():
     import jax.numpy as jnp
 
     dev = jax.devices()[0]
-    off = {"MXNET_CONV_BWD_LAYOUT": None, "BENCH_STEM_S2D": None,
-           "MXNET_CONV_S2D": None}
-    candidates = [
-        # explicit None: a flag inherited from the caller's shell must
-        # not silently turn the baseline row into a lever row
-        ("baseline", dict(off)),
-        ("conv_bwd_nhwc", {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC"}),
-        ("stem_s2d", {**off, "BENCH_STEM_S2D": "1"}),
-        ("s2d_strided",
-         {**off, "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
-        ("nhwc+s2d_strided",
-         {**off, "MXNET_CONV_BWD_LAYOUT": "NHWC",
-          "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
-    ]
-    rows = [measure(jax, jnp, tag, env) for tag, env in candidates]
-    # Compiler-option probes (in-process per-compile XLA knobs; an
-    # unsupported flag just lands as an error row). These explore
-    # whether deeper fusion headroom moves the conv-heavy step; they
-    # do NOT participate in the lever cache (env-only levers do).
-    for tag, opts in (
-        ("xla_vmem_48m", {"xla_tpu_scoped_vmem_limit_kib": "49152"}),
-        ("xla_lhs_scheduler",
-         {"xla_tpu_enable_latency_hiding_scheduler": "true"}),
-    ):
-        rows.append(measure(jax, jnp, tag, dict(off),
-                            compiler_options=opts))
+    # EXP_ONLY=tag1,tag2 runs a subset — the wedge-resilient mode: the
+    # tunnel dies a few minutes into a claim, so each row can run in
+    # its OWN process/claim and rows merge into the shared result file
+    # by tag (fresh measurement wins) until the set is complete.
+    only = None
+    if os.environ.get("EXP_ONLY"):
+        only = {t.strip() for t in os.environ["EXP_ONLY"].split(",")}
+        unknown = only - {t for t, _ in CANDIDATES + COMPILER_PROBES}
+        if unknown:
+            raise SystemExit("EXP_ONLY unknown tags: %s" % sorted(unknown))
+    rows = [measure(jax, jnp, tag, env) for tag, env in CANDIDATES
+            if only is None or tag in only]
+    for tag, opts in COMPILER_PROBES:
+        if only is None or tag in only:
+            rows.append(measure(jax, jnp, tag, dict(OFF),
+                                compiler_options=opts))
     for r in rows:
         print(json.dumps(r), file=sys.stderr)
-    out = {"batch": BATCH, "scan_k": SCAN_K,
-           "platform": dev.platform,
-           "device_kind": getattr(dev, "device_kind", "?"),
-           "rows": rows}
     tag = os.environ.get("EXP_TAG", "v5e_r4")
     res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "results")
     path = os.path.join(res_dir, "conv_bwd_experiments_%s.json" % tag)
+    # merge with any prior rows for this tag (same regime AND same
+    # platform only — a CPU smoke row must never mix into a TPU sweep
+    # and feed the hardware-only lever cache)
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+        if (prior.get("batch"), prior.get("scan_k"),
+                prior.get("platform")) == (BATCH, SCAN_K, dev.platform):
+            fresh = {r["tag"] for r in rows}
+            rows = [r for r in prior.get("rows", [])
+                    if r.get("tag") not in fresh] + rows
+    except (FileNotFoundError, ValueError):
+        pass
+    order = {t: i for i, (t, _) in enumerate(CANDIDATES + COMPILER_PROBES)}
+    rows.sort(key=lambda r: order.get(r.get("tag"), 99))
+    out = {"batch": BATCH, "scan_k": SCAN_K,
+           "platform": dev.platform,
+           "device_kind": getattr(dev, "device_kind", "?"),
+           "rows": rows}
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
 
@@ -116,11 +146,20 @@ def main():
     # (BENCH_AUTOTUNE=0 disables) and stamps it in its output. Only a
     # real-accelerator measurement may write the cache.
     if dev.platform in ("tpu", "axon"):
-        ok = [(r, env) for r, (t, env)
-              in zip(rows[:len(candidates)], candidates)  # env rows only
-              if "images_per_sec" in r]
+        env_by_tag = dict(CANDIDATES)
+        ok = [(r, env_by_tag[r["tag"]]) for r in rows  # env rows only
+              if r.get("tag") in env_by_tag and "images_per_sec" in r]
         base = next((r for r, _ in ok if r["tag"] == "baseline"), None)
-        if base and len(ok) > 1:
+        # The cache is (re)written only once EVERY candidate has been
+        # attempted in this merged sweep: under EXP_ONLY the sweep
+        # lands row by row across processes, and a partial set must
+        # not clobber a previously confirmed winner with a premature
+        # no-winner record (or crown an interim winner the remaining
+        # rows would beat). An errored row counts as attempted — a
+        # permanently broken lever must not block the cache forever.
+        attempted = {r.get("tag") for r in rows}
+        complete = all(t in attempted for t, _ in CANDIDATES)
+        if base and len(ok) > 1 and complete:
             best, best_env = max(ok, key=lambda p: p[0]["images_per_sec"])
             cache = {
                 "measured_on": out["device_kind"],

@@ -1,0 +1,182 @@
+"""Wedge-resilient TPU job queue: one short claim per healthy window.
+
+The axon tunnel has been observed (2026-07-30/31, docs/TPU_OPERATIONS.md)
+to grant short claims reliably but die a few minutes into sustained
+work. Amortizing many measurements into one process — the natural
+design — is therefore exactly wrong here. This runner inverts it:
+
+  * jobs are SMALL (one compile + one measurement each, own process);
+  * before each job the tunnel is probed (tools/tpu_health.py, short
+    claim, single claimant);
+  * a wedged probe sleeps `--interval` and retries — the tunnel has
+    recovered on its own after idle periods;
+  * a job that exceeds its timeout is SIGTERMed (grace, then SIGKILL),
+    marked `wedged`, and retried up to --retries times, AFTER the
+    other pending jobs (round-robin, so one cursed job can't starve
+    the queue);
+  * all state lives in a JSON file, so the queue resumes across
+    runner restarts and sessions.
+
+Seed file format (benchmarks/results/hw_queue_state.json):
+  {"jobs": [{"name": ..., "argv": [...], "env": {...},
+             "timeout_s": 420}, ...]}
+Runner adds: status (pending/running/ok/failed/wedged), rc, wall_s,
+attempts, log_tail, finished_at.
+
+Run:  nohup python tools/hw_queue.py --interval 480 > /tmp/hw_queue.log 2>&1 &
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE_DEFAULT = os.path.join(REPO, "benchmarks", "results",
+                             "hw_queue_state.json")
+
+
+def log(msg):
+    print("[hw_queue %s] %s" % (time.strftime("%H:%M:%S"), msg),
+          flush=True)
+
+
+def load_state(path):
+    with open(path) as f:
+        state = json.load(f)
+    # A job stuck in 'running' means a previous runner died mid-job
+    # (only one runner may own a state file): reclassify as wedged so
+    # it gets rescheduled instead of silently dropped.
+    for j in state["jobs"]:
+        if j.get("status") == "running":
+            j["status"] = "wedged"
+            j["note"] = "runner died mid-job; reclaimed on restart"
+    return state
+
+
+def save_state(path, state):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, path)
+
+
+def probe_health(timeout=120):
+    """healthy/wedged/error via the single-claimant pre-flight probe.
+
+    Never raises: a probe that itself hangs or dies is reported as a
+    state so the long-lived runner sleeps and retries instead of
+    crashing in exactly the wedge scenario it exists to ride out."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "tpu_health.py"),
+             "--timeout", str(timeout), "--json"],
+            capture_output=True, text=True, timeout=timeout + 60)
+    except subprocess.TimeoutExpired:
+        return {"state": "wedged", "note": "health probe itself hung"}
+    except OSError as e:
+        return {"state": "error", "note": str(e)[:200]}
+    try:
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"state": "error", "stderr": p.stderr[-200:]}
+
+
+def run_job(job):
+    """Run one job to completion or timeout; returns updated fields."""
+    env = dict(os.environ)
+    env.update(job.get("env") or {})
+    t0 = time.time()
+    p = subprocess.Popen(
+        job["argv"], cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True)  # own group: kill children too
+    try:
+        out, _ = p.communicate(timeout=job.get("timeout_s", 420))
+        if p.returncode == 0:
+            status = "ok"
+        elif p.returncode in (job.get("wedge_rcs") or []):
+            # e.g. bench.py's stall guard exits 3 after emitting the
+            # partial row — a tunnel wedge, not a code failure: retry
+            status = "wedged"
+        else:
+            status = "failed"
+    except subprocess.TimeoutExpired:
+        os.killpg(p.pid, signal.SIGTERM)
+        try:
+            out, _ = p.communicate(timeout=25)
+        except subprocess.TimeoutExpired:
+            # SIGKILL is the documented claim-poison trigger, but a
+            # hung process holds the claim anyway; reclaim by force.
+            os.killpg(p.pid, signal.SIGKILL)
+            out, _ = p.communicate()
+        status = "wedged"
+    return {
+        "status": status,
+        "rc": p.returncode,
+        "wall_s": round(time.time() - t0, 1),
+        "log_tail": (out or "")[-1500:],
+        "finished_at": time.strftime("%m-%d %H:%M:%S"),
+    }
+
+
+def next_job(jobs, retries):
+    """Pending first (seed order); then wedged ones with attempts left,
+    fewest attempts first (round-robin — one cursed job must not burn
+    consecutive claim windows while others wait for their first retry)."""
+    for j in jobs:
+        if j.get("status", "pending") == "pending":
+            return j
+    wedged = [j for j in jobs
+              if j.get("status") == "wedged"
+              and j.get("attempts", 1) <= retries]
+    return min(wedged, key=lambda j: j.get("attempts", 1), default=None)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--state", default=STATE_DEFAULT)
+    ap.add_argument("--interval", type=int, default=480,
+                    help="sleep (s) after a wedged probe or job")
+    ap.add_argument("--settle", type=int, default=20,
+                    help="sleep (s) between healthy jobs (claim settle)")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="extra attempts for a wedged job")
+    ap.add_argument("--once", action="store_true",
+                    help="run at most one job, then exit")
+    args = ap.parse_args(argv)
+
+    while True:
+        state = load_state(args.state)
+        job = next_job(state["jobs"], args.retries)
+        if job is None:
+            log("queue drained: %s" % json.dumps(
+                {j["name"]: j.get("status") for j in state["jobs"]}))
+            return 0
+        h = probe_health()
+        if h.get("state") != "healthy":
+            log("tunnel %s; sleeping %ds (next job: %s)"
+                % (h.get("state"), args.interval, job["name"]))
+            time.sleep(args.interval)
+            continue
+        job["attempts"] = job.get("attempts", 0) + 1
+        job["status"] = "running"
+        save_state(args.state, state)
+        log("running %s (attempt %d): %s"
+            % (job["name"], job["attempts"], " ".join(job["argv"])))
+        job.update(run_job(job))
+        save_state(args.state, state)
+        log("%s -> %s (rc=%s, %.0fs)"
+            % (job["name"], job["status"], job.get("rc"), job["wall_s"]))
+        if args.once:
+            return 0
+        time.sleep(args.interval if job["status"] == "wedged"
+                   else args.settle)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

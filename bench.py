@@ -888,7 +888,9 @@ def main():
     # rows landed, then the fetch wedged and the money row was lost),
     # and the stall guard emits rows in measurement order — so rows run
     # by value-per-minute: bf16 scan (the judged MFU row) -> bf16 wall
-    # -> f32 b256 -> b512 scan -> real input.
+    # -> b512 scan -> real input -> f32 b256 (last: the f32 row must
+    # measure the default graph, so it runs after the bf16-regime
+    # lever env is unwound).
     if on_tpu and BATCH2 > BATCH and not over_deadline(
             out, "bf16_batch%d_and_all_downstream_rows" % BATCH2):
         # bf16 mixed-precision rows (reference fp16 recipe, TPU dtype):

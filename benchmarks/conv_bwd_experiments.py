@@ -137,8 +137,12 @@ def main():
            "platform": dev.platform,
            "device_kind": getattr(dev, "device_kind", "?"),
            "rows": rows}
-    with open(path, "w") as f:
+    # atomic replace: hw_queue may SIGKILL a timed-out job mid-write,
+    # and a truncated file would silently discard every accumulated row
+    # at the next merge
+    with open(path + ".tmp", "w") as f:
         json.dump(out, f, indent=1)
+    os.replace(path + ".tmp", path)
 
     # Autotune cache (the reference's cudnn_tune idea, whole-step
     # flavor): record the winning lever set when it beats baseline by
@@ -185,9 +189,10 @@ def main():
                 # so bench.py never keeps applying a lever the latest
                 # hardware sweep failed to confirm
                 cache.update({"best": "baseline", "env": {}})
-            with open(os.path.join(res_dir, "levers_v5e.json"),
-                      "w") as f:
+            cpath = os.path.join(res_dir, "levers_v5e.json")
+            with open(cpath + ".tmp", "w") as f:
                 json.dump(cache, f, indent=1)
+            os.replace(cpath + ".tmp", cpath)  # never half-written
             print(json.dumps({"levers_cache": cache}), file=sys.stderr)
     print(json.dumps({"written": path, "rows": rows}))
 

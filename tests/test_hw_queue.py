@@ -1,0 +1,138 @@
+"""tools/hw_queue.py: the wedge-resilient short-claim TPU job queue.
+
+Everything runs with a stubbed health probe and /bin/sh jobs — no jax,
+no TPU claim. The queue exists because the axon tunnel grants short
+claims reliably but dies minutes into sustained work, so measurement
+jobs are small subprocesses gated on health probes with durable state
+(docs/TPU_OPERATIONS.md).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import hw_queue  # noqa: E402
+
+
+@pytest.fixture()
+def state_file(tmp_path, monkeypatch):
+    monkeypatch.setattr(hw_queue, "probe_health",
+                        lambda timeout=120: {"state": "healthy"})
+    return str(tmp_path / "state.json")
+
+
+def _seed(path, jobs):
+    with open(path, "w") as f:
+        json.dump({"jobs": jobs}, f)
+
+
+def _drain(path, **kw):
+    args = ["--state", path, "--retries", str(kw.get("retries", 0)),
+            "--settle", "0", "--interval", "1"]
+    assert hw_queue.main(args) == 0
+    with open(path) as f:
+        return json.load(f)["jobs"]
+
+
+def test_status_transitions(state_file):
+    _seed(state_file, [
+        {"name": "ok", "argv": ["/bin/sh", "-c", "echo fine"],
+         "timeout_s": 30},
+        {"name": "bad", "argv": ["/bin/sh", "-c", "echo broken>&2; exit 1"],
+         "timeout_s": 30},
+        {"name": "hang", "argv": ["/bin/sh", "-c", "sleep 60"],
+         "timeout_s": 1},
+        {"name": "stallguard", "argv": ["/bin/sh", "-c", "exit 3"],
+         "timeout_s": 30, "wedge_rcs": [3]},
+    ])
+    jobs = {j["name"]: j for j in _drain(state_file)}
+    assert jobs["ok"]["status"] == "ok"
+    assert jobs["bad"]["status"] == "failed"
+    assert "broken" in jobs["bad"]["log_tail"]
+    # a timed-out job and a stall-guard exit are both tunnel wedges
+    assert jobs["hang"]["status"] == "wedged"
+    assert jobs["stallguard"]["status"] == "wedged"
+
+
+def test_wedged_job_retried_until_budget(state_file):
+    _seed(state_file, [{"name": "h", "argv": ["/bin/sh", "-c", "sleep 60"],
+                        "timeout_s": 1}])
+    jobs = _drain(state_file, retries=2)
+    assert jobs[0]["status"] == "wedged"
+    assert jobs[0]["attempts"] == 3  # 1 initial + 2 retries
+
+
+def test_wedged_retries_round_robin():
+    jobs = [{"name": "a", "status": "wedged", "attempts": 3},
+            {"name": "b", "status": "wedged", "attempts": 1}]
+    assert hw_queue.next_job(jobs, retries=3)["name"] == "b"
+
+
+def test_unhealthy_probe_sleeps_then_retries(state_file, monkeypatch):
+    calls = []
+
+    def flaky(timeout=120):
+        calls.append(1)
+        return {"state": "wedged" if len(calls) == 1 else "healthy"}
+
+    monkeypatch.setattr(hw_queue, "probe_health", flaky)
+    _seed(state_file, [{"name": "late", "argv": ["/bin/true"],
+                        "timeout_s": 30}])
+    jobs = _drain(state_file)
+    assert jobs[0]["status"] == "ok" and len(calls) == 2
+
+
+def test_orphaned_running_job_reclaimed(state_file):
+    _seed(state_file, [{"name": "orphan", "argv": ["/bin/true"],
+                        "timeout_s": 5, "status": "running",
+                        "attempts": 1}])
+    state = hw_queue.load_state(state_file)
+    assert state["jobs"][0]["status"] == "wedged"
+
+
+def test_jobs_appended_mid_run_survive(state_file, monkeypatch):
+    """The runner must not rewrite the file from a stale snapshot: jobs
+    the operator appends while another job runs must still execute."""
+    _seed(state_file, [{"name": "slow", "argv": ["/bin/sh", "-c",
+                                                 "sleep 0.2"],
+                        "timeout_s": 30}])
+    orig_run = hw_queue.run_job
+    appended = []
+
+    def run_and_append(job):
+        if not appended:
+            with open(state_file) as f:
+                s = json.load(f)
+            s["jobs"].append({"name": "appended", "argv": ["/bin/true"],
+                              "timeout_s": 5})
+            with open(state_file, "w") as f:
+                json.dump(s, f)
+            appended.append(1)
+        return orig_run(job)
+
+    monkeypatch.setattr(hw_queue, "run_job", run_and_append)
+    jobs = {j["name"]: j["status"] for j in _drain(state_file)}
+    assert jobs == {"slow": "ok", "appended": "ok"}
+
+
+def test_duplicate_names_deduped(state_file):
+    """Duplicate names would make the by-name update ambiguous and can
+    loop the runner forever (the later copy stays pending)."""
+    _seed(state_file, [{"name": "d", "argv": ["/bin/true"], "timeout_s": 5},
+                       {"name": "d", "argv": ["/bin/false"], "timeout_s": 5}])
+    jobs = _drain(state_file)
+    assert len(jobs) == 1 and jobs[0]["status"] == "ok"
+
+
+def test_probe_crash_reported_not_raised(monkeypatch):
+    import subprocess
+
+    def hang(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", hang)
+    assert hw_queue.probe_health()["state"] == "wedged"

@@ -48,6 +48,15 @@ def log(msg):
 def load_state(path):
     with open(path) as f:
         state = json.load(f)
+    # Job names are the identity update_job keys on: a duplicate name
+    # would make the by-name replace ambiguous and can loop the runner
+    # forever (the later copy stays pending). Keep the first.
+    seen, unique = set(), []
+    for j in state["jobs"]:
+        if j["name"] not in seen:
+            seen.add(j["name"])
+            unique.append(j)
+    state["jobs"] = unique
     # A job stuck in 'running' means a previous runner died mid-job
     # (only one runner may own a state file): reclassify as wedged so
     # it gets rescheduled instead of silently dropped.
@@ -63,6 +72,23 @@ def save_state(path, state):
     with open(tmp, "w") as f:
         json.dump(state, f, indent=1)
     os.replace(tmp, path)
+
+
+def update_job(path, job):
+    """Read-modify-write ONE job's record by name.
+
+    The runner must never rewrite the whole file from a snapshot taken
+    before a multi-minute job: the operator may append new jobs to the
+    file while a job runs, and a wholesale save from stale memory would
+    silently delete them."""
+    state = load_state(path)
+    for i, j in enumerate(state["jobs"]):
+        if j["name"] == job["name"]:
+            state["jobs"][i] = job
+            break
+    else:
+        state["jobs"].append(job)
+    save_state(path, state)
 
 
 def probe_health(timeout=120):
@@ -165,11 +191,11 @@ def main(argv=None):
             continue
         job["attempts"] = job.get("attempts", 0) + 1
         job["status"] = "running"
-        save_state(args.state, state)
+        update_job(args.state, job)
         log("running %s (attempt %d): %s"
             % (job["name"], job["attempts"], " ".join(job["argv"])))
         job.update(run_job(job))
-        save_state(args.state, state)
+        update_job(args.state, job)
         log("%s -> %s (rc=%s, %.0fs)"
             % (job["name"], job["status"], job.get("rc"), job["wall_s"]))
         if args.once:

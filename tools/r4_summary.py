@@ -1,0 +1,119 @@
+"""Digest the round-4 hardware sweep results into one readable block.
+
+Reads benchmarks/results/{hw_queue_state,conv_bwd_experiments_*,
+mirror_sweep_*,benchmark_score_*,transformer_bench_*,bench_r4_*,
+levers_v5e}.json (whatever exists) and prints:
+  - queue job status board
+  - lever A/B table vs baseline + the live autotune cache
+  - bench row MFU progression (r3 recorded -> r4 captured)
+  - mirror-policy sweep cost/saving table
+
+Pure host-side file reading — safe to run any time (never touches the
+TPU). Usage: python tools/r4_summary.py [tag_substring=v5e_r4b]
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+RES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "benchmarks", "results")
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def main():
+    tag = sys.argv[1] if len(sys.argv) > 1 else "v5e_r4b"
+
+    q = _load(os.path.join(RES, "hw_queue_state.json"))
+    if q:
+        print("== queue ==")
+        for j in q["jobs"]:
+            line = "  %-28s %s" % (j["name"], j.get("status", "pending"))
+            if j.get("wall_s"):
+                line += "  (%.0fs, attempts %d)" % (
+                    j["wall_s"], j.get("attempts", 1))
+            print(line)
+
+    for path in sorted(glob.glob(
+            os.path.join(RES, "conv_bwd_experiments_*%s*.json" % tag))):
+        exp = _load(path)
+        if not exp:
+            continue
+        print("== lever A/B (%s, batch %s scan %s, %s) =="
+              % (os.path.basename(path), exp.get("batch"),
+                 exp.get("scan_k"), exp.get("platform")))
+        base = next((r for r in exp["rows"]
+                     if r.get("tag") == "baseline"
+                     and "images_per_sec" in r), None)
+        for r in exp["rows"]:
+            if "images_per_sec" in r:
+                rel = (" %+6.1f%%" % (100 * (r["images_per_sec"]
+                                             / base["images_per_sec"] - 1))
+                       if base and r is not base else "")
+                print("  %-20s %9.2f img/s  %7.2f ms%s"
+                      % (r["tag"], r["images_per_sec"], r["step_ms"], rel))
+            else:
+                print("  %-20s ERROR %s" % (r.get("tag"),
+                                            r.get("error", "?")[:80]))
+
+    cache = _load(os.path.join(RES, "levers_v5e.json"))
+    if cache:
+        print("== autotune cache ==")
+        print("  best=%s env=%s gain=%s (from %s)"
+              % (cache.get("best"), cache.get("env"),
+                 cache.get("gain_vs_baseline"), cache.get("source")))
+
+    benches = sorted(glob.glob(os.path.join(RES, "bench_r4_*.json")))
+    if benches:
+        print("== bench rows (newest: %s) ==" % os.path.basename(benches[-1]))
+        b = _load(benches[-1]) or {}
+        for k in sorted(b):
+            if k.endswith("mfu") and b[k] is not None:
+                print("  %-40s %.1f%%" % (k, 100 * b[k]))
+            elif k.endswith("images_per_sec"):
+                print("  %-40s %.1f img/s" % (k, b[k]))
+        if b.get("value"):
+            print("  %-40s %.1f img/s (vs_baseline %sx)"
+                  % ("value[%s]" % b.get("metric"), b["value"],
+                     b.get("vs_baseline")))
+        if b.get("autotuned_levers"):
+            print("  autotuned_levers: %s" % b["autotuned_levers"])
+        if b.get("partial_reason"):
+            print("  PARTIAL: %s" % b["partial_reason"])
+
+    for path in sorted(glob.glob(
+            os.path.join(RES, "mirror_sweep_*%s*.json" % tag))):
+        m = _load(path)
+        if not m:
+            continue
+        plain = m.get("plain", {})
+        print("== mirror sweep (batch %s; plain %.1f img/s) =="
+              % (m.get("batch"), plain.get("img_s", 0.0)))
+        for k, v in m.items():
+            if isinstance(v, dict) and "img_s" in v and k != "plain":
+                cost = (100 * (1 - v["img_s"] / plain["img_s"])
+                        if plain.get("img_s") else float("nan"))
+                print("  %-26s %7.1f img/s (cost %4.1f%%)  temp x%.3f"
+                      % (k, v["img_s"], cost, v.get("temp_ratio", 0)))
+
+    for pat, label in (("benchmark_score_*%s*.json", "inference score"),
+                       ("transformer_bench_*%s*.json", "transformer MFU")):
+        for path in sorted(glob.glob(os.path.join(RES, pat % tag))):
+            d = _load(path)
+            if d:
+                print("== %s (%s) ==" % (label, os.path.basename(path)))
+                for r in d.get("rows", [d]):
+                    print("  " + json.dumps(r)[:120])
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,16 @@ def over_deadline(out, row_name):
     log("deadline %ds exceeded; skipping %s" % (DEADLINE_S, row_name))
     return True
 
+
+# Row names for BENCH_ROWS subset selection (subclaim mode runs one or
+# two per child process): calib, b32, scan32, bf16scan, bf16wall, b512,
+# real, f32b256. Unset = all rows (the classic single-process flow).
+def _row_enabled(name):
+    rows = os.environ.get("BENCH_ROWS")
+    if not rows:
+        return True
+    return name in {r.strip() for r in rows.split(",")}
+
 # Spec-sheet bf16 peak TFLOP/s per chip, keyed by substrings of
 # jax.devices()[0].device_kind (NEVER an env var -- the round-2 bench
 # trusted PALLAS_AXON_TPU_GEN and reported a physically impossible 294%
@@ -347,6 +357,170 @@ def init_backend():
     devs = jax.devices("cpu")
     return jax, "cpu (accelerator probe failed %s s)" % (
         "+".join(str(s) for s in INIT_SCHEDULE)), True
+
+
+def _health_probe_subprocess(timeout_s=120):
+    """tools/tpu_health.py in a subprocess: claim-safe healthy/other."""
+    try:
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "tpu_health.py"),
+             "--timeout", str(timeout_s), "--json"],
+            capture_output=True, text=True, timeout=timeout_s + 60)
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — any probe failure = not healthy
+        return {"state": "error", "note": str(e)[:200]}
+
+
+def _spawn_row_child(rows, timeout_s, extra_env):
+    """Run `python bench.py` for a row subset in its own process/claim.
+
+    Returns (payload_dict_or_None, status, wall_s). SIGTERM + grace on
+    timeout (SIGKILL poisons the claim; last resort only). The child's
+    one-JSON-line contract is the transport: last parseable stdout line
+    wins, so a stall-guard partial emission still delivers its rows."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["BENCH_ROWS"] = rows
+    env["BENCH_SUBCLAIMS"] = "0"
+    env.setdefault("BENCH_STALL", "300")
+    env.setdefault("BENCH_INIT_SCHEDULE", "60")
+    # the child must emit whatever it measured BEFORE the parent's
+    # timeout fires: a SIGTERMed child prints nothing and loses its
+    # rows, so its soft deadline sits well inside the hard timeout
+    env["BENCH_DEADLINE"] = str(max(120, timeout_s - 90))
+    t0 = time.perf_counter()
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=None, text=True,  # stderr: inherit
+        env=env)                                         # (stage logs)
+    try:
+        stdout, _ = p.communicate(timeout=timeout_s)
+        status = "ok" if p.returncode == 0 else "rc=%d" % p.returncode
+    except subprocess.TimeoutExpired:
+        p.terminate()
+        try:
+            stdout, _ = p.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            log("WARNING: row child [%s] ignored SIGTERM; SIGKILL "
+                "(can poison the chip claim)" % rows)
+            p.kill()
+            stdout, _ = p.communicate()
+        status = "timeout"
+    payload = None
+    for line in reversed((stdout or "").splitlines()):
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict):
+            payload = cand
+            break
+    return payload, status, round(time.perf_counter() - t0, 1)
+
+
+# (child name, BENCH_ROWS subset, timeout_s, wants_flops_hint) in
+# value-per-minute order — same rationale as the single-process row
+# order, but a wedge now costs ONE child, not the run.
+_SUBCLAIM_PLAN = (
+    ("b32", "calib,b32", 420, False),
+    ("bf16scan", "bf16scan", 420, True),
+    ("scan32", "scan32", 420, True),
+    ("bf16wall", "bf16wall", 420, False),
+    ("b512", "b512", 480, True),
+    ("real", "real", 540, True),
+    ("f32b256", "f32b256", 420, False),
+)
+
+# keys that describe a child RUN, not a measured row: kept out of the
+# merged payload (recorded per-child under "subclaims" instead)
+_CHILD_META_KEYS = ("partial_stall_s", "partial_reason",
+                    "recorded_tpu_result", "rows_skipped_for_deadline",
+                    "error", "stage")
+
+
+def run_subclaims():
+    """Wedge-resilient whole-bench flow: one short claim per row group.
+
+    The tunnel has wedged mid-run in THREE separate multi-row bench
+    attempts (2026-07-30/31) while short claims kept working — so the
+    parent never dials the tunnel at all: it health-probes, then runs
+    each row group as its own `bench.py BENCH_ROWS=...` subprocess and
+    merges their JSON lines into the one-line contract. Returns True
+    if it emitted (caller returns); False = not applicable (fall back
+    to the classic single-process flow)."""
+    h = _health_probe_subprocess()
+    if h.get("state") != "healthy":
+        log("subclaims: tunnel %s; classic flow handles fallback"
+            % h.get("state"))
+        return False
+    log("subclaims: tunnel healthy (%s); running %d row children"
+        % (h.get("device_kind"), len(_SUBCLAIM_PLAN)))
+    merged = {"metric": METRIC, "value": 0.0, "unit": "images/sec",
+              "vs_baseline": None, "bench_mode": "subclaims"}
+    subclaims = {}
+    flops_b32 = None
+    for name, rows, timeout_s, wants_hint in _SUBCLAIM_PLAN:
+        if over_deadline(merged, name):
+            subclaims[name] = {"status": "skipped_deadline"}
+            continue
+        extra = {}
+        if wants_hint and flops_b32:
+            extra["BENCH_FLOPS_B32"] = repr(flops_b32)
+        payload, status, wall_s = _spawn_row_child(rows, timeout_s, extra)
+        meta = {"status": status, "wall_s": wall_s}
+        if payload:
+            for k in _CHILD_META_KEYS:
+                if k in payload:
+                    meta[k] = payload.pop(k)
+            for k, v in payload.items():
+                if k == "value":
+                    if v:
+                        merged["value"] = v
+                elif k == "vs_baseline":
+                    # fail() emits 0.0 — only a real multiple may land
+                    if v:
+                        merged["vs_baseline"] = v
+                elif k not in merged:
+                    merged[k] = v
+            tf = payload.get("tflops_per_step")
+            if tf:
+                flops_b32 = tf * 1e12
+            pk = (payload.get("peak_tflops_spec")
+                  or payload.get("calib_matmul_tflops"))
+            if pk and "BENCH_PEAK_HINT" not in os.environ:
+                # children resolve the spec peak themselves; the hint
+                # only matters when the kind lookup fails (then only
+                # the calibrating b32 child would have a denominator)
+                os.environ["BENCH_PEAK_HINT"] = repr(pk)
+        else:
+            meta["status"] = meta["status"] + " (no payload)"
+        subclaims[name] = meta
+        log("subclaim %s: %s (%.0fs)" % (name, meta["status"], wall_s))
+        if name != _SUBCLAIM_PLAN[-1][0]:
+            time.sleep(15)  # let the claim settle before the next child
+    # cross-child derived field: real-input efficiency vs synthetic
+    pre = "with_real_input_bf16_batch%d_" % BATCH2
+    syn = merged.get("bf16_batch%d_images_per_sec" % BATCH2)
+    if merged.get(pre + "images_per_sec") and syn:
+        ratio = merged[pre + "images_per_sec"] / syn
+        merged[pre + "vs_synthetic"] = round(ratio, 3)
+        if ratio < 0.9:
+            merged[pre + "note"] = (
+                "input-pipeline-limited on this host (decode ceiling "
+                "%.0f img/s, %d cores)"
+                % (merged.get("input_decode_only_images_per_sec", 0.0),
+                   os.cpu_count() or 0))
+    merged["subclaims"] = subclaims
+    if not merged["value"]:
+        # primary row never landed: attach recorded provenance like the
+        # classic flow would
+        rec = recorded_hardware_result()
+        if rec is not None:
+            merged["recorded_tpu_result"] = rec
+    emit(merged)
+    return True
 
 
 _BUILD_MEMO = {}  # (batch, bf16, scan_k, copts, lever env) -> (run, flops)
@@ -758,6 +932,16 @@ def _arm_stall_guard(out, stall_s):
 
 def main():
     global STEPS, WARMUP
+    # Subclaim mode (default): each row group in its own short claim.
+    # BENCH_SUBCLAIMS=0 forces the classic single-process flow;
+    # BENCH_ROWS set means THIS process is a row child.
+    if (os.environ.get("BENCH_SUBCLAIMS", "1") == "1"
+            and not os.environ.get("BENCH_ROWS")):
+        try:
+            if run_subclaims():
+                return
+        except Exception as e:  # noqa: BLE001 — orchestrator bug must
+            log("subclaims orchestrator failed (%s); classic flow" % e)
     jax, platform, fell_back = init_backend()
     if fell_back:
         # Shorten the run so the fallback number lands inside the harness
@@ -794,7 +978,7 @@ def main():
         _arm_stall_guard(out, int(os.environ.get("BENCH_STALL", "420")))
 
     calib_tflops = None
-    if on_tpu:
+    if on_tpu and _row_enabled("calib"):
         stage("calibrate")
         try:
             calib_tflops = calibrate_matmul_tflops(jax, jnp)
@@ -815,15 +999,21 @@ def main():
     peak = spec_peak
     if calib_tflops and (peak is None or calib_tflops > 1.5 * peak):
         peak = calib_tflops
+    if peak is None and os.environ.get("BENCH_PEAK_HINT"):
+        # row-child mode: denominator resolved by the calibrating child
+        peak = float(os.environ["BENCH_PEAK_HINT"])
 
-    stage("build")
-    img_s, step_ms, flops, ovh = run_resnet50(jax, jnp, BATCH, STEPS, WARMUP)
-    out["value"] = round(img_s, 2)
-    out["step_ms"] = round(step_ms, 2)
-    # vs_baseline only comparable at the reference's batch size
-    out["vs_baseline"] = (
-        round(img_s / BASELINE_IMG_S, 3) if BATCH == 32 else None
-    )
+    flops = ovh = None
+    if _row_enabled("b32"):
+        stage("build")
+        img_s, step_ms, flops, ovh = run_resnet50(
+            jax, jnp, BATCH, STEPS, WARMUP)
+        out["value"] = round(img_s, 2)
+        out["step_ms"] = round(step_ms, 2)
+        # vs_baseline only comparable at the reference's batch size
+        out["vs_baseline"] = (
+            round(img_s / BASELINE_IMG_S, 3) if BATCH == 32 else None
+        )
     if fell_back:
         # CPU stand-in number: attach the most recent committed REAL
         # hardware measurement with provenance (tunnel outages are
@@ -835,7 +1025,12 @@ def main():
         out["peak_tflops_spec"] = spec_peak
     if calib_tflops:
         out["calib_matmul_tflops"] = round(calib_tflops, 1)
-    out.update(mfu_fields("", step_ms, flops, peak))
+    if not flops and os.environ.get("BENCH_FLOPS_B32"):
+        # row-child mode: per-step flops at the reference batch, handed
+        # down by the subclaim parent from the b32 child's cost analysis
+        flops = float(os.environ["BENCH_FLOPS_B32"])
+    if _row_enabled("b32") and flops:
+        out.update(mfu_fields("", step_ms, flops, peak))
 
     def _device_est(prefix, step_ms_row, flops_row, overhead_ms):
         """Tunnel-corrected estimate: wall-clock rows stay primary; the
@@ -858,12 +1053,13 @@ def main():
         fields.update(m)
         return fields
 
-    out.update(_device_est("", step_ms, flops, ovh))
+    if _row_enabled("b32"):
+        out.update(_device_est("", step_ms, flops, ovh))
 
     # scan row at the REFERENCE batch size (VERDICT r3 weak #2: the b32
     # row was 42% dispatch overhead; one K-step dispatch measures the
     # true small-batch device rate instead of estimating it)
-    if on_tpu:
+    if on_tpu and _row_enabled("scan32"):
         scan_k32 = int(os.environ.get("BENCH_SCAN_K", "8"))
         if scan_k32 > 1 and not over_deadline(out, "scan_b%d" % BATCH):
             try:
@@ -906,7 +1102,7 @@ def main():
         scan_k = int(os.environ.get("BENCH_SCAN_K", "8"))
         step_ms5 = None
         pre5 = "bf16_batch%d_scan%d_" % (BATCH2, scan_k)
-        if scan_k > 1 and not over_deadline(
+        if scan_k > 1 and _row_enabled("bf16scan") and not over_deadline(
                 out, "bf16_batch%d_scan" % BATCH2):
             try:
                 img_s5, step_ms5, _, _ = run_resnet50(
@@ -920,7 +1116,8 @@ def main():
             except Exception as e:
                 log("scan-%d run failed: %s" % (scan_k, e))
                 out["scan_error"] = str(e)[:200]
-        if not over_deadline(out, "bf16_batch%d" % BATCH2):
+        if _row_enabled("bf16wall") and not over_deadline(
+                out, "bf16_batch%d" % BATCH2):
             try:
                 img_s3, step_ms3, flops3b, ovh3 = run_resnet50(
                     jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP,
@@ -945,7 +1142,7 @@ def main():
         # batch-512 bf16 scan row: the largest-batch device-rate point
         # (HBM-permitting; reported as an error field if it OOMs)
         b3 = int(os.environ.get("BENCH_BATCH3", "512"))
-        if (b3 > BATCH2 and scan_k > 1
+        if (b3 > BATCH2 and scan_k > 1 and _row_enabled("b512")
                 and not over_deadline(out, "bf16_batch%d" % b3)):
             # same knob gates every scan row
             try:
@@ -964,7 +1161,8 @@ def main():
                 out["batch%d_error" % b3] = str(e)[:200]
         # END-TO-END row: real .rec input through native decode into the
         # same fused step (every other row is synthetic-fed)
-        if not over_deadline(out, "with_real_input"):
+        if _row_enabled("real") and not over_deadline(
+                out, "with_real_input"):
             try:
                 img_s6, step_ms6, dec_img_s = run_resnet50_real_input(
                     jax, jnp, BATCH2, max(STEPS // 2, 5), 2, bf16=True)
@@ -991,7 +1189,8 @@ def main():
         # not a VERDICT row, kept for round-over-round continuity.
         for k in lever_restore:
             os.environ.pop(k, None)
-        if not over_deadline(out, "batch%d" % BATCH2):
+        if _row_enabled("f32b256") and not over_deadline(
+                out, "batch%d" % BATCH2):
             try:
                 img_s2, step_ms2, flops2, ovh2 = run_resnet50(
                     jax, jnp, BATCH2, max(STEPS // 2, 5), WARMUP)

@@ -64,7 +64,11 @@ def measure(jax, jnp, name, bf16):
 
     (module, kwargs), hw, batch, base = MODELS[name]
     if SMOKE:
-        batch, hw = 2, 64 if module != "inception_v3" else 128
+        # smallest spatial size each stem supports: inception-v3's
+        # tower needs >=128, alexnet's stride-4 stem + fixed fc1
+        # underflows below the real 224
+        batch = 2
+        hw = {"inception_v3": 128, "alexnet": 224}.get(module, 64)
     sym = build_symbol(module, kwargs, hw)
     program = _GraphProgram(sym)
     data_shape = (batch, 3, hw, hw)
@@ -90,6 +94,10 @@ def measure(jax, jnp, name, bf16):
     lr, momentum, wd = 0.1, 0.9, 1e-4
     moms = {n: np.zeros_like(v) for n, v in params.items()}
 
+    # models with Dropout (alexnet, vgg) need an rng at train time; a
+    # fixed key is fine for throughput measurement
+    rng_key = jax.random.PRNGKey(0)
+
     def train_step(ps, ms, ax, data, label):
         def loss_fn(p):
             if bf16:
@@ -97,7 +105,7 @@ def measure(jax, jnp, name, bf16):
             args = dict(p)
             args["data"] = data.astype(jnp.bfloat16) if bf16 else data
             args["softmax_label"] = label
-            outs, new_ax = program(args, ax, None, True)
+            outs, new_ax = program(args, ax, rng_key, True)
             return jnp.sum(outs[0].astype(jnp.float32)), new_ax
 
         grads, new_ax = jax.grad(loss_fn, has_aux=True)(ps)

@@ -950,6 +950,14 @@ def main():
         try:
             if run_subclaims():
                 return
+            # The orchestrator already spent a full health probe
+            # learning the tunnel is down; the classic flow must not
+            # re-burn the whole 30+120+210s schedule on top of it or
+            # the CPU fallback lands outside the harness kill window
+            # (~560s observed round 1). One short re-probe suffices.
+            global INIT_SCHEDULE
+            if "BENCH_INIT_SCHEDULE" not in os.environ:
+                INIT_SCHEDULE = (45,)
         except Exception as e:  # noqa: BLE001 — orchestrator bug must
             log("subclaims orchestrator failed (%s); classic flow" % e)
     jax, platform, fell_back = init_backend()

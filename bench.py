@@ -471,6 +471,44 @@ def run_subclaims():
               "vs_baseline": None, "bench_mode": "subclaims"}
     subclaims = {}
     flops_b32 = None
+
+    # The parent emits once at the end — so a harness kill mid-plan
+    # would otherwise capture NOTHING (strictly worse than the classic
+    # flow's stall guard). Two escape hatches emit the merged-so-far:
+    # a deadline guard thread (fires just inside BENCH_DEADLINE) and a
+    # SIGTERM handler. Children orphaned by the early exit finish
+    # their row and release their claim on their own.
+    done = threading.Event()
+
+    def _partial_emit(why):
+        if done.is_set():
+            return
+        snap = dict(merged)
+        snap["subclaims"] = dict(subclaims)
+        snap["partial_reason"] = why
+        if not snap.get("value"):
+            rec = recorded_hardware_result()
+            if rec is not None:
+                snap["recorded_tpu_result"] = rec
+        emit(snap)
+        os._exit(3)
+
+    def _deadline_guard():
+        remaining = DEADLINE_S - 45 - (time.monotonic() - _T_START)
+        if remaining > 0:
+            done.wait(remaining)
+        if not done.is_set():
+            _partial_emit("subclaim plan exceeded BENCH_DEADLINE-45s; "
+                          "rows present are the children that finished")
+
+    threading.Thread(target=_deadline_guard, daemon=True).start()
+    try:
+        import signal as _signal
+        _signal.signal(
+            _signal.SIGTERM,
+            lambda *a: _partial_emit("SIGTERM during subclaim plan"))
+    except (ValueError, OSError):
+        pass  # non-main thread (tests): deadline guard still covers
     for name, rows, timeout_s, wants_hint in _SUBCLAIM_PLAN:
         if over_deadline(merged, name):
             subclaims[name] = {"status": "skipped_deadline"}
@@ -529,6 +567,7 @@ def run_subclaims():
         rec = recorded_hardware_result()
         if rec is not None:
             merged["recorded_tpu_result"] = rec
+    done.set()  # disarm the deadline guard / SIGTERM partial emit
     emit(merged)
     return True
 

@@ -151,6 +151,34 @@ def test_no_value_attaches_recorded_provenance(healthy, reset_emit,
     assert merged["recorded_tpu_result"]["value"] == 1139.0
 
 
+def test_deadline_guard_emits_partial(tmp_path):
+    """If the plan overruns BENCH_DEADLINE the parent must emit the
+    merged-so-far and exit 3 — a harness kill mid-plan must never
+    capture nothing. Driven in a real subprocess (the guard os._exits)."""
+    import subprocess
+    script = tmp_path / "drive.py"
+    script.write_text(
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "bench.DEADLINE_S = 2\n"
+        "bench._health_probe_subprocess = (\n"
+        "    lambda timeout_s=120: {'state': 'healthy'})\n"
+        "def slow(rows, t, e):\n"
+        "    time.sleep(30)\n"
+        "    return None, 'timeout', 30.0\n"
+        "bench._spawn_row_child = slow\n"
+        "bench.recorded_hardware_result = lambda: None\n"
+        "bench.run_subclaims()\n"
+        % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p = subprocess.run([sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=25)
+    assert p.returncode == 3, (p.returncode, p.stderr[-300:])
+    payload = json.loads(p.stdout.strip().splitlines()[-1])
+    assert "partial_reason" in payload
+    assert payload["bench_mode"] == "subclaims"
+
+
 def test_row_enabled_subsetting(monkeypatch):
     monkeypatch.delenv("BENCH_ROWS", raising=False)
     assert bench._row_enabled("b32") and bench._row_enabled("real")

@@ -28,6 +28,38 @@ import traceback
 
 import numpy as np
 
+class TunnelWedgeError(RuntimeError):
+    """The axon tunnel died mid-claim (transport-level failure).
+
+    Retrying in-process is hopeless — the claim is poisoned; callers
+    should emit whatever they have and exit with the wedge code (3) so
+    the job queue reschedules them instead of burning the timeout."""
+
+
+# Transport-status signatures of a dead tunnel, as observed in
+# docs/TPU_OPERATIONS.md triggers (e.g. "INTERNAL: http://...:8093/
+# remote_compile: read body: response body closed before all bytes
+# were read"). Deliberately NOT the bare endpoint name: every
+# server-side compile rejection also routes through /remote_compile,
+# and a deterministic rejection classified as a wedge would be
+# retried forever by the job queue.
+_TUNNEL_ERROR_SIGNS = ("response body closed", "unavailable:",
+                       "deadline_exceeded", "socket closed",
+                       "connection reset", "connection refused",
+                       "broken pipe")
+# Graph-level statuses = real failures, never retryable wedges — they
+# veto even if a transport-ish phrase appears in the same message.
+_TUNNEL_ERROR_VETO = ("invalid_argument", "resource_exhausted",
+                      "unimplemented:", "not_found")
+
+
+def is_tunnel_error(err):
+    m = str(err).lower()
+    if any(v in m for v in _TUNNEL_ERROR_VETO):
+        return False
+    return any(s in m for s in _TUNNEL_ERROR_SIGNS)
+
+
 BASELINE_IMG_S = 109.0  # reference resnet-50 batch-32 on K80
 BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 BATCH2 = int(os.environ.get("BENCH_BATCH2", "256"))
@@ -237,11 +269,17 @@ _EMIT_LOCK = threading.Lock()
 
 
 def emit(payload):
+    """Print the one JSON line; returns True iff THIS call won the race.
+
+    Guard threads must key their `os._exit(3)` on the return value: a
+    guard that loses the race to the normal completion path must not
+    relabel a successful run with the retryable wedge code."""
     with _EMIT_LOCK:  # deadline guard vs normal path: first wins
         if _EMITTED.is_set():
-            return
+            return False
         _EMITTED.set()
         print(json.dumps(payload), flush=True)
+        return True
 
 
 def fail(exc):
@@ -262,7 +300,30 @@ def fail(exc):
             out["recorded_tpu_result"] = rec
     emit(out)
     traceback.print_exc(file=sys.stderr)
-    sys.exit(0)
+    # a tunnel death is a retryable wedge, not a code failure: exit with
+    # the wedge code so hw_queue reschedules instead of recording 'ok'
+    # with a 0.0-value error payload
+    wedge = isinstance(exc, TunnelWedgeError) or is_tunnel_error(exc)
+    sys.exit(3 if wedge else 0)
+
+
+def _row_wedge_guard(out, e):
+    """First statement of every per-row error handler in the classic
+    flow: a tunnel death must end the RUN (the claim is dead; later
+    rows would each burn their timeout on it), emitting the rows
+    measured so far and exiting with the retryable wedge code — while
+    an ordinary row failure returns here and lands as that row's error
+    field as before."""
+    if not (isinstance(e, TunnelWedgeError) or is_tunnel_error(e)):
+        return
+    out["partial_reason"] = ("tunnel wedged mid-run: %s"
+                             % (str(e)[:200] or "wedge"))
+    if not out.get("value"):
+        rec = recorded_hardware_result()
+        if rec is not None:
+            out.setdefault("recorded_tpu_result", rec)
+    emit(out)
+    sys.exit(3)
 
 
 def _probe_backend_subprocess(timeout_s):
@@ -490,8 +551,8 @@ def run_subclaims():
             rec = recorded_hardware_result()
             if rec is not None:
                 snap["recorded_tpu_result"] = rec
-        emit(snap)
-        os._exit(3)
+        if emit(snap):  # lost race = run completed normally; stand down
+            os._exit(3)
 
     def _deadline_guard():
         remaining = DEADLINE_S - 45 - (time.monotonic() - _T_START)
@@ -710,6 +771,11 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0,
         # must not poison later rows out of their retry
         _BUILD_MEMO[memo_key] = (run, flops_per_step)
     except Exception as e:
+        if is_tunnel_error(e):
+            # a dead tunnel killed the compile (compiler-probe rows
+            # included); first-call jit would just hang on the same
+            # dead claim until the harness SIGTERMs it
+            raise TunnelWedgeError(str(e)[:300]) from e
         if compiler_options:
             # a rejected option must FAIL the row — the first-call-jit
             # fallback would silently measure the default config under
@@ -969,11 +1035,13 @@ def _arm_stall_guard(out, stall_s):
                 rec = recorded_hardware_result()
                 if rec is not None:
                     snap["recorded_tpu_result"] = rec
-            emit(snap)
             # Exit nonzero so harnesses keyed on exit status can tell a
             # wedged run from a clean one (the JSON line is still the
-            # primary contract; partial_reason carries the detail).
-            os._exit(3)
+            # primary contract; partial_reason carries the detail). A
+            # lost emit race means the run completed normally between
+            # the stall check and here: stand down.
+            if emit(snap):
+                os._exit(3)
 
     t = threading.Thread(target=guard, daemon=True)
     t.start()
@@ -1047,6 +1115,7 @@ def main():
                 log("calibration: %.1f TFLOP/s bf16 matmul (spec %s for %r)"
                     % (calib_tflops, spec_peak, kind))
         except Exception as e:
+            _row_wedge_guard(out, e)
             log("calibration failed: %s" % e)
     # Denominator for MFU: the spec peak for the identified chip. The
     # calibration only replaces it when the kind lookup failed, or when
@@ -1133,6 +1202,7 @@ def main():
                     m.pop(pre + "tflops_per_step", None)
                     out.update(m)
             except Exception as e:
+                _row_wedge_guard(out, e)
                 log("b%d scan run failed: %s" % (BATCH, e))
                 out["scan_b%d_error" % BATCH] = str(e)[:200]
 
@@ -1171,6 +1241,7 @@ def main():
                     m.pop(pre5 + "tflops_per_step", None)
                     out.update(m)
             except Exception as e:
+                _row_wedge_guard(out, e)
                 log("scan-%d run failed: %s" % (scan_k, e))
                 out["scan_error"] = str(e)[:200]
         if _row_enabled("bf16wall") and not over_deadline(
@@ -1194,6 +1265,7 @@ def main():
                 out.update(_device_est("bf16_batch%d_" % BATCH2,
                                        step_ms3, flops3, ovh3))
             except Exception as e:
+                _row_wedge_guard(out, e)
                 log("bf16 run failed: %s" % e)
                 out["bf16_error"] = str(e)[:200]
         # batch-512 bf16 scan row: the largest-batch device-rate point
@@ -1214,6 +1286,7 @@ def main():
                     m.pop(pre + "tflops_per_step", None)
                     out.update(m)
             except Exception as e:
+                _row_wedge_guard(out, e)
                 log("b%d run failed: %s" % (b3, e))
                 out["batch%d_error" % b3] = str(e)[:200]
         # END-TO-END row: real .rec input through native decode into the
@@ -1238,6 +1311,7 @@ def main():
                             "ceiling %.0f img/s, %d cores)"
                             % (dec_img_s, os.cpu_count() or 0))
             except Exception as e:
+                _row_wedge_guard(out, e)
                 log("real-input run failed: %s" % e)
                 out["real_input_error"] = str(e)[:200]
         # f32 reference-dtype large-batch row LAST, with the lever env
@@ -1258,6 +1332,7 @@ def main():
                 out.update(_device_est("batch%d_" % BATCH2, step_ms2,
                                        flops2, ovh2))
             except Exception as e:
+                _row_wedge_guard(out, e)
                 log("batch-%d run failed: %s" % (BATCH2, e))
                 out["batch%d_error" % BATCH2] = str(e)[:200]
     emit(out)

@@ -53,7 +53,16 @@ def measure(jax, jnp, tag, env, compiler_options=None):
         return {"tag": tag, "images_per_sec": round(img_s, 2),
                 "step_ms": round(step_ms, 2),
                 "wall_s": round(time.perf_counter() - t0, 1)}
+    except bench.TunnelWedgeError:
+        # not a property of this lever — the tunnel died under it;
+        # propagate so the sweep stops NOW and the row stays
+        # unattempted (the queue will retry it on a fresh claim)
+        raise
     except Exception as e:  # noqa: BLE001 — record and continue sweep
+        if bench.is_tunnel_error(e):
+            # a tunnel death during warmup/measure dispatch (not just
+            # compile) must also stop the sweep, not land as an error row
+            raise bench.TunnelWedgeError(str(e)[:300]) from e
         return {"tag": tag, "error": str(e)[:300]}
     finally:
         for k, v in saved.items():
@@ -106,17 +115,28 @@ def main():
         unknown = only - {t for t, _ in CANDIDATES + COMPILER_PROBES}
         if unknown:
             raise SystemExit("EXP_ONLY unknown tags: %s" % sorted(unknown))
-    rows = [measure(jax, jnp, tag, env) for tag, env in CANDIDATES
-            if only is None or tag in only]
-    for tag, opts in COMPILER_PROBES:
-        if only is None or tag in only:
-            rows.append(measure(jax, jnp, tag, dict(OFF),
-                                compiler_options=opts))
+    import bench
+
+    rows, wedged = [], None
+    try:
+        for tag, env in CANDIDATES:
+            if only is None or tag in only:
+                rows.append(measure(jax, jnp, tag, env))
+        for tag, opts in COMPILER_PROBES:
+            if only is None or tag in only:
+                rows.append(measure(jax, jnp, tag, dict(OFF),
+                                    compiler_options=opts))
+    except bench.TunnelWedgeError as e:
+        # emit + merge whatever completed, then exit with the wedge
+        # code so hw_queue reschedules instead of marking us failed
+        # (`or`: an argless TunnelWedgeError must still register)
+        wedged = str(e)[:300] or "tunnel wedge"
     for r in rows:
         print(json.dumps(r), file=sys.stderr)
     tag = os.environ.get("EXP_TAG", "v5e_r4")
-    res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "results")
+    res_dir = os.environ.get("EXP_RESULTS_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results")
+    os.makedirs(res_dir, exist_ok=True)
     path = os.path.join(res_dir, "conv_bwd_experiments_%s.json" % tag)
     # merge with any prior rows for this tag (same regime AND same
     # platform only — a CPU smoke row must never mix into a TPU sweep
@@ -195,6 +215,9 @@ def main():
             os.replace(cpath + ".tmp", cpath)  # never half-written
             print(json.dumps({"levers_cache": cache}), file=sys.stderr)
     print(json.dumps({"written": path, "rows": rows}))
+    if wedged:
+        print(json.dumps({"wedged": wedged}), file=sys.stderr)
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":

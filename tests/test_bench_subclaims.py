@@ -185,3 +185,67 @@ def test_row_enabled_subsetting(monkeypatch):
     monkeypatch.setenv("BENCH_ROWS", "calib,b32")
     assert bench._row_enabled("b32") and bench._row_enabled("calib")
     assert not bench._row_enabled("bf16scan")
+
+
+def test_tunnel_error_signatures():
+    # the exact transport failure observed 2026-07-31 (remote_compile
+    # died mid-claim) must classify as a wedge, and graph-level compile
+    # errors must NOT (they're real failures, not retryable wedges)
+    assert bench.is_tunnel_error(
+        "INTERNAL: http://127.0.0.1:8093/remote_compile: read body: "
+        "response body closed before all bytes were read")
+    assert bench.is_tunnel_error("UNAVAILABLE: TPU backend setup/compile error")
+    assert not bench.is_tunnel_error(
+        "INVALID_ARGUMENT: Mismatched shapes in convolution")
+    assert not bench.is_tunnel_error("RESOURCE_EXHAUSTED: out of HBM")
+    # a server-side rejection routed through the tunnel endpoint is a
+    # deterministic failure, not a retryable wedge (the veto wins even
+    # when transport-ish phrases share the message)
+    assert not bench.is_tunnel_error(
+        "INTERNAL: http://127.0.0.1:8093/remote_compile: "
+        "INVALID_ARGUMENT: unknown compiler option")
+
+
+def test_row_wedge_guard(reset_emit, monkeypatch):
+    # wedge: emits the rows measured so far and exits 3
+    out = {"value": 1234.0, "platform": "tpu"}
+    with pytest.raises(SystemExit) as ei:
+        bench._row_wedge_guard(out, RuntimeError(
+            "UNAVAILABLE: TPU backend setup/compile error"))
+    assert ei.value.code == 3
+    assert len(reset_emit) == 1
+    payload = reset_emit[0]
+    assert payload["value"] == 1234.0
+    assert "wedged mid-run" in payload["partial_reason"]
+    # non-wedge: returns, row handler records the error as before
+    bench._row_wedge_guard({}, ValueError("bad shape"))
+    assert len(reset_emit) == 1
+
+
+def test_experiments_sweep_stops_on_wedge(monkeypatch, tmp_path):
+    # a TunnelWedgeError mid-sweep must write the completed rows and
+    # exit 3 (hw_queue's retryable wedge code), not burn the remaining
+    # candidates' timeouts on a dead claim
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import conv_bwd_experiments as exp
+
+    calls = []
+
+    def fake_run(jax, jnp, batch, steps, warmup, bf16=False, scan_k=0,
+                 compiler_options=None):
+        calls.append(1)
+        if len(calls) == 1:
+            return 1000.0, 10.0, None, None
+        raise bench.TunnelWedgeError("response body closed")
+
+    monkeypatch.setattr(bench, "run_resnet50", fake_run)
+    monkeypatch.setenv("EXP_RESULTS_DIR", str(tmp_path))
+    monkeypatch.setenv("EXP_SMOKE", "1")
+    monkeypatch.setenv("EXP_TAG", "wedge_unit")
+    monkeypatch.delenv("EXP_ONLY", raising=False)
+    with pytest.raises(SystemExit) as ei:
+        exp.main()
+    assert ei.value.code == 3
+    assert len(calls) == 2  # stopped at the wedge, didn't sweep on

@@ -692,6 +692,7 @@ def _build_resnet50_step(jax, jnp, batch, bf16=False, scan_k=0,
                 os.environ.get("BENCH_STEM_S2D"),
                 os.environ.get("MXNET_CONV_S2D"),
                 os.environ.get("MXNET_CONV_BWD_LAYOUT"),
+                os.environ.get("MXNET_CONV_WGRAD"),
                 os.environ.get("MXNET_MIRROR_SAVE"),
                 os.environ.get("MXNET_BACKWARD_DO_MIRROR"))
 
@@ -1097,6 +1098,8 @@ def main():
         out["conv_s2d_strided"] = True
     if os.environ.get("MXNET_CONV_BWD_LAYOUT"):
         out["conv_bwd_layout"] = os.environ["MXNET_CONV_BWD_LAYOUT"]
+    if os.environ.get("MXNET_CONV_WGRAD"):
+        out["conv_wgrad"] = os.environ["MXNET_CONV_WGRAD"]
     if on_tpu:
         # armed BEFORE the first real device work (calibration fetches
         # go through the same tunnel that wedges)

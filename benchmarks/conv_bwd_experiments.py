@@ -7,7 +7,7 @@ with all rows — one process, one tunnel claim, no subprocess sweeps
 (XLA_FLAGS-style sweeps need a fresh process per config, which multiplies
 claim cycles; the in-process env knobs below don't).
 
-Candidates (7 rows — 5 lever rows + 2 compiler-option probes — one
+Candidates (9 rows — 7 lever rows + 2 compiler-option probes — one
 fresh compile each; budget tunnel time accordingly):
   baseline            current default
   conv_bwd_nhwc       MXNET_CONV_BWD_LAYOUT=NHWC (backward convs in
@@ -17,7 +17,11 @@ fresh compile each; budget tunnel time accordingly):
   s2d_strided         + MXNET_CONV_S2D=1 (EVERY stride-2 conv lowered to
                       s2d space: dgrad loses its zero-stuffed
                       lhs-dilation, ops/nn.py _conv2d_s2d_strided)
-  nhwc+s2d_strided    all levers together
+  nhwc+s2d_strided    NHWC + s2d levers together
+  wgrad_patches       MXNET_CONV_WGRAD=patches (filter grad as ONE
+                      patches x grad dot_general, f32 accumulation,
+                      ops/nn.py _conv2d_wgrad_patches)
+  wgrad+s2d_strided   patches wgrad + s2d levers together
 
 Run: python benchmarks/conv_bwd_experiments.py
 """
@@ -73,7 +77,7 @@ def measure(jax, jnp, tag, env, compiler_options=None):
 
 
 OFF = {"MXNET_CONV_BWD_LAYOUT": None, "BENCH_STEM_S2D": None,
-       "MXNET_CONV_S2D": None}
+       "MXNET_CONV_S2D": None, "MXNET_CONV_WGRAD": None}
 # explicit None: a flag inherited from the caller's shell must
 # not silently turn the baseline row into a lever row
 CANDIDATES = [
@@ -84,6 +88,14 @@ CANDIDATES = [
      {**OFF, "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
     ("nhwc+s2d_strided",
      {**OFF, "MXNET_CONV_BWD_LAYOUT": "NHWC",
+      "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
+    # wgrad as one patches x grad dot_general (f32 accumulation);
+    # composes with s2d (stride-2 convs take the s2d branch, the rest
+    # take the patches wgrad) but NOT with NHWC (that branch wins the
+    # elif chain for every conv)
+    ("wgrad_patches", {**OFF, "MXNET_CONV_WGRAD": "patches"}),
+    ("wgrad+s2d_strided",
+     {**OFF, "MXNET_CONV_WGRAD": "patches",
       "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
 ]
 # Compiler-option probes (in-process per-compile XLA knobs; an

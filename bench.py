@@ -84,6 +84,34 @@ METRIC = "resnet50_train_images_per_sec_batch%d" % BATCH
 # the stall guard (which handles no-progress wedges, not slow runs).
 DEADLINE_S = int(os.environ.get("BENCH_DEADLINE", "1500"))
 _T_START = time.monotonic()
+# Persistent XLA compile cache (see tools/hw_queue.py rationale): a
+# recompile of an already-seen program costs ~0 instead of 30-120 s of
+# tunnel claim time. The env var alone is NOT enough in this
+# environment — the axon sitecustomize imports jax at interpreter
+# start, capturing config defaults before any user code runs — so
+# enable_compile_cache() must also be called after `import jax`.
+# BENCH_COMPILE_CACHE=0 opts out.
+if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+
+
+def enable_compile_cache(jax):
+    """Point jax's persistent compile cache at .jax_cache/ (idempotent).
+
+    Call after `import jax` anywhere a fresh process compiles real
+    programs; sitecustomize has already captured the config default by
+    then, so only an explicit config update takes effect."""
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") != "1":
+        return
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        log("compile cache unavailable: %s" % e)
 
 
 def over_deadline(out, row_name):
@@ -406,6 +434,7 @@ def init_backend():
     stage("backend-init")
     import jax
 
+    enable_compile_cache(jax)
     for attempt, timeout_s in enumerate(INIT_SCHEDULE, 1):
         plat = _probe_backend_subprocess(timeout_s)
         if plat is not None:

@@ -34,6 +34,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# must precede any jax import (the config default is captured then);
+# see bench.py / tools/hw_queue.py for the claim-time rationale
+if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+
 BATCH = int(os.environ.get("EXP_BATCH", "256"))
 SCAN_K = int(os.environ.get("EXP_SCAN_K", "8"))
 DISPATCHES = int(os.environ.get("EXP_DISPATCHES", "3"))
@@ -112,6 +120,9 @@ COMPILER_PROBES = [
 def main():
     import jax
 
+    import bench
+
+    bench.enable_compile_cache(jax)
     if os.environ.get("EXP_SMOKE") == "1":
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -127,8 +138,6 @@ def main():
         unknown = only - {t for t, _ in CANDIDATES + COMPILER_PROBES}
         if unknown:
             raise SystemExit("EXP_ONLY unknown tags: %s" % sorted(unknown))
-    import bench
-
     rows, wedged = [], None
     try:
         for tag, env in CANDIDATES:

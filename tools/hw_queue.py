@@ -115,6 +115,14 @@ def probe_health(timeout=120):
 def run_job(job):
     """Run one job to completion or timeout; returns updated fields."""
     env = dict(os.environ)
+    # Persistent XLA compile cache shared across jobs: compiles through
+    # the tunnel cost 30-120 s of claim time and a claim that dies
+    # mid-compile loses all of it; with the cache, a retry (or a later
+    # job compiling the same program, e.g. bench row children repeating
+    # lever-sweep graphs) loads the executable instead of recompiling.
+    # Harmless if the backend can't serialize executables (jax skips).
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
     env.update(job.get("env") or {})
     t0 = time.time()
     p = subprocess.Popen(

@@ -249,3 +249,22 @@ def test_experiments_sweep_stops_on_wedge(monkeypatch, tmp_path):
         exp.main()
     assert ei.value.code == 3
     assert len(calls) == 2  # stopped at the wedge, didn't sweep on
+
+
+def test_emit_self_records_tpu_rows(monkeypatch, tmp_path):
+    # a TPU emission persists to BENCH_SAVE for round-over-round
+    # provenance; CPU fallbacks and row children must NOT save
+    save = tmp_path / "bench_saved.json"
+    monkeypatch.setenv("BENCH_SAVE", str(save))
+    monkeypatch.delenv("BENCH_ROWS", raising=False)
+    bench._save_result({"platform": "tpu", "value": 42.0})
+    assert json.loads(save.read_text())["value"] == 42.0
+
+    save2 = tmp_path / "bench_saved2.json"
+    monkeypatch.setenv("BENCH_SAVE", str(save2))
+    bench._save_result({"platform": "cpu (probe failed)", "value": 1.0})
+    assert not save2.exists()
+
+    monkeypatch.setenv("BENCH_ROWS", "b32")
+    bench._save_result({"platform": "tpu", "value": 2.0})
+    assert not save2.exists()

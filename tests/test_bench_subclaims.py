@@ -268,3 +268,47 @@ def test_emit_self_records_tpu_rows(monkeypatch, tmp_path):
     monkeypatch.setenv("BENCH_ROWS", "b32")
     bench._save_result({"platform": "tpu", "value": 2.0})
     assert not save2.exists()
+
+
+def test_exp_force_cache_crowns_partial_sweep(monkeypatch, tmp_path):
+    # EXP_FORCE_CACHE=1 writes the lever cache from whatever rows have
+    # landed, so one cursed candidate can't block autotune forever
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import conv_bwd_experiments as exp
+
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    class FakeJax:
+        @staticmethod
+        def devices():
+            return [FakeDev()]
+
+        class config:
+            @staticmethod
+            def update(*a):
+                pass
+
+    FakeJax.numpy = FakeJax  # satisfies `import jax.numpy as jnp`
+
+    rates = {"baseline": 1000.0, "s2d_strided": 1100.0}
+
+    def fake_measure(jax, jnp, tag, env, compiler_options=None):
+        return {"tag": tag, "images_per_sec": rates[tag], "step_ms": 1.0}
+
+    monkeypatch.setattr(exp, "measure", fake_measure)
+    monkeypatch.setitem(sys.modules, "jax", FakeJax)
+    monkeypatch.setitem(sys.modules, "jax.numpy", FakeJax)
+    monkeypatch.setenv("EXP_RESULTS_DIR", str(tmp_path))
+    monkeypatch.setenv("EXP_TAG", "force_unit")
+    monkeypatch.setenv("EXP_ONLY", "baseline,s2d_strided")
+    monkeypatch.setenv("EXP_FORCE_CACHE", "1")
+    monkeypatch.delenv("EXP_SMOKE", raising=False)
+    exp.main()
+    cache = json.loads((tmp_path / "levers_v5e.json").read_text())
+    assert cache["best"] == "s2d_strided"
+    assert cache["env"] == {"MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}
+    assert cache["gain_vs_baseline"] == pytest.approx(1.1)

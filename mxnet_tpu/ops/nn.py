@@ -296,10 +296,14 @@ def _conv2d_wgrad_patches(data, weight, stride, pad, dilate):
         d, w = res
         _, dgrad_vjp = jax.vjp(lambda dd: plain(dd, w), d)
         gd, = dgrad_vjp(g)
-        patches = jax.lax.conv_general_dilated_patches(
-            d, filter_shape=w.shape[2:], window_strides=stride,
-            padding=[(p, p) for p in pad], rhs_dilation=dilate,
-            dimension_numbers=_conv_dn(2))
+        if (w.shape[2:] == (1, 1) and tuple(stride) == (1, 1)
+                and tuple(pad) == (0, 0)):
+            patches = d  # 1x1/s1: the receptive field IS the input
+        else:
+            patches = jax.lax.conv_general_dilated_patches(
+                d, filter_shape=w.shape[2:], window_strides=stride,
+                padding=[(p, p) for p in pad], rhs_dilation=dilate,
+                dimension_numbers=_conv_dn(2))
         # patches: (N, C*kh*kw, OH, OW) with feature order (c, kh, kw);
         # g: (N, O, OH, OW). Contract over (N, OH, OW) in ONE matmul.
         ckk = patches.shape[1]

@@ -384,6 +384,37 @@ def _row_wedge_guard(out, e):
     sys.exit(3)
 
 
+BENCH_LOCK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_lock")
+
+
+def _hold_bench_lock():
+    """Advertise a live bench run so tools/hw_queue.py yields the tunnel.
+
+    The round driver runs bench.py directly; a queue job claiming the
+    chip in the same window would contend with (and can wedge) the
+    driver's run. Row children don't write it — their orchestrating
+    parent already holds it. Stale locks are harmless: the queue
+    verifies the recorded pid is alive before honoring the lock, and
+    os._exit paths (stall guard) leave only a dead-pid file behind."""
+    if os.environ.get("BENCH_ROWS"):
+        return
+    try:
+        with open(BENCH_LOCK, "w") as f:
+            f.write(str(os.getpid()))
+        import atexit
+        atexit.register(_release_bench_lock)
+    except OSError as e:
+        log("bench lock unavailable: %s" % e)
+
+
+def _release_bench_lock():
+    try:
+        os.remove(BENCH_LOCK)
+    except OSError:
+        pass
+
+
 def _probe_backend_subprocess(timeout_s):
     """Probe accelerator init in a SUBPROCESS so a hang is killable.
 
@@ -1109,6 +1140,7 @@ def _arm_stall_guard(out, stall_s):
 
 def main():
     global STEPS, WARMUP
+    _hold_bench_lock()
     # Subclaim mode (default): each row group in its own short claim.
     # BENCH_SUBCLAIMS=0 forces the classic single-process flow;
     # BENCH_ROWS set means THIS process is a row child.

@@ -136,3 +136,20 @@ def test_probe_crash_reported_not_raised(monkeypatch):
 
     monkeypatch.setattr(subprocess, "run", hang)
     assert hw_queue.probe_health()["state"] == "wedged"
+
+
+def test_bench_lock_holder(tmp_path, monkeypatch):
+    import hw_queue
+    monkeypatch.setattr(hw_queue, "REPO", str(tmp_path))
+    lock = tmp_path / ".bench_lock"
+    # no lock -> no holder
+    assert hw_queue.bench_lock_holder() is None
+    # live pid -> holder
+    lock.write_text(str(os.getpid()))
+    assert hw_queue.bench_lock_holder() == os.getpid()
+    # dead pid (stale lock after os._exit) -> ignored
+    lock.write_text("999999")
+    assert hw_queue.bench_lock_holder() is None
+    # garbage -> ignored
+    lock.write_text("not-a-pid")
+    assert hw_queue.bench_lock_holder() is None

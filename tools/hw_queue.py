@@ -158,6 +158,29 @@ def run_job(job):
     }
 
 
+def bench_lock_holder():
+    """Pid of a LIVE external bench.py run holding the tunnel, else None.
+
+    bench.py writes .bench_lock at start (the round driver runs it
+    directly); while the holder is alive the queue must not start jobs
+    — two claimants contending for the tunnel can wedge the driver's
+    round-end capture. A dead recorded pid (os._exit skips cleanup) is
+    ignored. The queue's own bench job is not a conflict: the lock
+    check happens between jobs, when that child has already exited."""
+    try:
+        with open(os.path.join(REPO, ".bench_lock")) as f:
+            pid = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return None
+    if pid <= 0:
+        return None
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return None
+    return pid
+
+
 def next_job(jobs, retries):
     """Pending first (seed order); then wedged ones with attempts left,
     fewest attempts first (round-robin — one cursed job must not burn
@@ -191,6 +214,11 @@ def main(argv=None):
             log("queue drained: %s" % json.dumps(
                 {j["name"]: j.get("status") for j in state["jobs"]}))
             return 0
+        holder = bench_lock_holder()
+        if holder:
+            log("bench.py pid %d holds the tunnel; yielding 60s" % holder)
+            time.sleep(60)
+            continue
         h = probe_health()
         if h.get("state") != "healthy":
             log("tunnel %s; sleeping %ds (next job: %s)"

@@ -72,7 +72,9 @@ def main():
               % (cache.get("best"), cache.get("env"),
                  cache.get("gain_vs_baseline"), cache.get("source")))
 
-    benches = sorted(glob.glob(os.path.join(RES, "bench_r4_*.json")))
+    benches = sorted(glob.glob(os.path.join(RES, "bench_r4_*.json"))
+                     + glob.glob(os.path.join(RES, "bench_live_*.json")),
+                     key=os.path.getmtime)  # newest LAST across both schemes
     if benches:
         print("== bench rows (newest: %s) ==" % os.path.basename(benches[-1]))
         b = _load(benches[-1]) or {}

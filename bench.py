@@ -388,6 +388,21 @@ BENCH_LOCK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".bench_lock")
 
 
+def _proc_start_ticks(pid):
+    """Kernel start time (clock ticks since boot) of `pid`, or None.
+
+    Field 22 of /proc/<pid>/stat — immune to pid reuse: a recycled pid
+    gets a fresh start time, so lock validation comparing this value
+    distinguishes the original holder from an unrelated process."""
+    try:
+        with open("/proc/%d/stat" % pid, "rb") as f:
+            data = f.read()
+        # comm can contain spaces/parens; fields resume after the last ')'
+        return int(data[data.rindex(b")") + 2:].split()[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 def _hold_bench_lock():
     """Advertise a live bench run so tools/hw_queue.py yields the tunnel.
 
@@ -395,13 +410,16 @@ def _hold_bench_lock():
     chip in the same window would contend with (and can wedge) the
     driver's run. Row children don't write it — their orchestrating
     parent already holds it. Stale locks are harmless: the queue
-    verifies the recorded pid is alive before honoring the lock, and
-    os._exit paths (stall guard) leave only a dead-pid file behind."""
+    verifies the recorded pid is alive AND that its /proc start time
+    matches the one recorded here (so a recycled pid can't make a dead
+    lock look live forever); os._exit paths (stall guard) leave only a
+    dead-pid file behind."""
     if os.environ.get("BENCH_ROWS"):
         return
     try:
         with open(BENCH_LOCK, "w") as f:
-            f.write(str(os.getpid()))
+            f.write("%d:%s" % (os.getpid(),
+                               _proc_start_ticks(os.getpid()) or ""))
         import atexit
         atexit.register(_release_bench_lock)
     except OSError as e:
@@ -660,7 +678,15 @@ def run_subclaims():
             lambda *a: _partial_emit("SIGTERM during subclaim plan"))
     except (ValueError, OSError):
         pass  # non-main thread (tests): deadline guard still covers
+    tunnel_dead = False
     for name, rows, timeout_s, wants_hint in _SUBCLAIM_PLAN:
+        if tunnel_dead:
+            # a previous child exited with the wedge code: the tunnel is
+            # known-dead, and every further child would burn a probe +
+            # compile window re-discovering that (mirrors the classic
+            # flow's _row_wedge_guard short-circuit)
+            subclaims[name] = {"status": "skipped_wedge"}
+            continue
         if over_deadline(merged, name):
             subclaims[name] = {"status": "skipped_deadline"}
             continue
@@ -697,6 +723,9 @@ def run_subclaims():
             meta["status"] = meta["status"] + " (no payload)"
         subclaims[name] = meta
         log("subclaim %s: %s (%.0fs)" % (name, meta["status"], wall_s))
+        if status == "rc=3":  # child classified a tunnel wedge
+            tunnel_dead = True
+            continue
         if name != _SUBCLAIM_PLAN[-1][0]:
             time.sleep(15)  # let the claim settle before the next child
     # cross-child derived field: real-input efficiency vs synthetic
@@ -720,6 +749,12 @@ def run_subclaims():
             merged["recorded_tpu_result"] = rec
     done.set()  # disarm the deadline guard / SIGTERM partial emit
     emit(merged)
+    if tunnel_dead:
+        # mirror the classic flow's _row_wedge_guard contract: the rows
+        # we forfeited are retryable, so the parent must exit with the
+        # wedge code (after emitting the merged partial above) or
+        # hw_queue records this job 'ok' and never reschedules it
+        sys.exit(3)
     return True
 
 

@@ -256,8 +256,75 @@ def main():
         },
         "claim": _claim(at256, step_ms_b32),
     }
+
+    # VERDICT r4 weak #5: fold in the measured SCHEDULE evidence from
+    # benchmarks/overlap_sched_probe.py — the r4 file assumed the
+    # overlap; this one records what the compiled program's own
+    # instruction schedule supports.
+    res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    sched = {}
+    for mode in ("tpu_aot", "cpu"):
+        p = os.path.join(res_dir, "overlap_sched_%s_r5.json" % mode)
+        if os.path.exists(p):
+            with open(p) as f:
+                sched[mode] = json.load(f)
+    if sched:
+        ev = {}
+        cpu = sched.get("cpu")
+        if cpu and "overlap_opportunity_coeff" in cpu:
+            ev["dependency_level"] = {
+                "source": "overlap_sched_cpu_r5.json (scheduled HLO of "
+                          "the compiled 8-device step)",
+                "finding": "the gradient all-reduces are scheduled "
+                           "INTERLEAVED with backward compute (first "
+                           "collective at instruction %s of %s; %s "
+                           "collectives), and %.0f%% of collective "
+                           "bytes have independent compute scheduled "
+                           "after their start — the dependency "
+                           "structure permits full overlap"
+                           % (cpu.get("first_collective_line"),
+                              cpu.get("entry_instructions"),
+                              cpu.get("collectives_sync", 0)
+                              + cpu.get("collectives_async_pairs", 0),
+                              100 * cpu["overlap_opportunity_coeff"]),
+                "overlap_opportunity_coeff":
+                    cpu["overlap_opportunity_coeff"],
+                "async_conversion_observed":
+                    cpu.get("collectives_async_pairs", 0) > 0,
+            }
+        tpu = sched.get("tpu_aot")
+        if tpu and "overlap_opportunity_coeff" in tpu:
+            ev["tpu_pipeline"] = {
+                "source": "overlap_sched_tpu_aot_r5.json (v5e AOT "
+                          "compile through the tunnel)",
+                "async_pairs": tpu.get("collectives_async_pairs", 0),
+                "overlap_opportunity_coeff":
+                    tpu["overlap_opportunity_coeff"],
+            }
+        elif tpu:
+            ev["tpu_pipeline"] = {"unavailable": tpu.get("error", "?")}
+        if "dependency_level" in ev:
+            ev["status"] = (
+                "measured: the schedule places every all-reduce as its "
+                "gradient becomes ready (not bunched at the end), so "
+                "overlap is limited by the backend's async-collective "
+                "runtime, not by the program. NOT yet measured: the "
+                "fraction of allreduce time the v5e runtime actually "
+                "hides; until the tpu_aot probe (queued) or a multi-chip "
+                "run lands, the defensible 256-chip number is the "
+                "zero-overlap floor %.1f%%, and the >=90%% bar remains "
+                "conditional on the scheduler doing its documented job."
+                % (100 * at256["eff_no_overlap"]))
+        else:
+            ev["status"] = (
+                "schedule evidence unavailable (cpu probe did not run); "
+                "the defensible 256-chip number is the zero-overlap "
+                "floor %.1f%%." % (100 * at256["eff_no_overlap"]))
+        out["overlap_evidence"] = ev
+
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "results", "scaling_model_r4.json")
+                        "results", "scaling_model_r5.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"written": path,

@@ -10,6 +10,7 @@ param-file codec, instead of the reference's per-method inline loops.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 from .. import metric as metric_mod
@@ -163,10 +164,69 @@ class BaseModule(object):
         if validation_metric is None:
             validation_metric = eval_metric
 
+        # MXNET_FIT_MULTISTEP=K: group K batches into ONE XLA dispatch
+        # (lax.scan over the fused step — Module.update_multi), amortizing
+        # host dispatch overhead the way the reference's threaded engine
+        # hides it (threaded_engine_perdevice.cc:26-136). Metric updates
+        # and batch callbacks still fire once per batch, after the group.
+        try:
+            fit_k = int(os.environ.get("MXNET_FIT_MULTISTEP", "1"))
+        except ValueError:
+            fit_k = 1
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
+            pending = []  # (nbatch, data_batch) awaiting a K-group flush
+
+            def _flush_group(pending, epoch, eval_metric):
+                def _cb_locals(nbatch, data_batch):
+                    # match the normal path's BatchEndParam.locals keys
+                    # (callbacks reading locals['self']/['data_batch']
+                    # must keep working under MXNET_FIT_MULTISTEP)
+                    return dict(self=self, train_data=train_data,
+                                data_batch=data_batch, epoch=epoch,
+                                nbatch=nbatch, eval_metric=eval_metric,
+                                monitor=monitor)
+
+                if len(pending) == fit_k:
+                    steps = self.update_multi([b for _, b in pending])
+                    for (nbatch, db), outs in zip(pending, steps):
+                        self._fused_outs_raw = outs
+                        self._fused_outputs = None
+                        self.update_metric(eval_metric, db.label)
+                        _fire(batch_end_callback, epoch, nbatch,
+                              eval_metric, _cb_locals(nbatch, db))
+                else:
+                    # partial trailing group: single-step path (already
+                    # compiled; a one-off K'-step compile isn't worth it)
+                    for nbatch, db in pending:
+                        self.forward_backward(db)
+                        self.update()
+                        self.update_metric(eval_metric, db.label)
+                        _fire(batch_end_callback, epoch, nbatch,
+                              eval_metric, _cb_locals(nbatch, db))
+
             for nbatch, data_batch in enumerate(train_data):
+                use_multi = (
+                    fit_k > 1 and monitor is None
+                    and getattr(self, "_fused_trainer", None) is not None
+                    and hasattr(self, "update_multi")
+                )
+                if use_multi:
+                    if (pending and any(
+                            tuple(p.shape) != tuple(d.shape)
+                            for p, d in zip(pending[0][1].data,
+                                            data_batch.data))):
+                        # shape break (e.g. last partial batch): flush
+                        # what we have before starting a new group
+                        _flush_group(pending, epoch, eval_metric)
+                        pending = []
+                    pending.append((nbatch, data_batch))
+                    if len(pending) == fit_k:
+                        _flush_group(pending, epoch, eval_metric)
+                        pending = []
+                    continue
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
@@ -176,6 +236,9 @@ class BaseModule(object):
                     monitor.toc_print()
                 _fire(batch_end_callback, epoch, nbatch, eval_metric,
                       locals())
+            if pending:
+                _flush_group(pending, epoch, eval_metric)
+                pending = []
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -611,6 +611,82 @@ class Module(BaseModule):
                 kvstore=self._kvstore
             )
 
+    def update_multi(self, data_batches):
+        """Run len(data_batches) fused training steps in ONE XLA dispatch
+        (lax.scan over the fused step; ShardedTrainStep.compile_multi).
+
+        Used by fit() under MXNET_FIT_MULTISTEP=K to amortize the
+        per-dispatch host overhead (~13.7 ms vs ~11.6 ms device time on
+        the tunneled v5e b32 row — VERDICT r4 #3); the reference hides
+        the same overhead with its threaded engine
+        (threaded_engine_perdevice.cc:26-136). Per-step math, lr
+        schedule, and num_update advance identically to K update()
+        calls. Returns a list of per-step raw output lists so the
+        caller can update metrics per micro-step (Speedometer
+        semantics). Requires the fused path and identically-shaped
+        batches."""
+        assert self._fused_trainer is not None, "fused path required"
+        assert self._fused_batch is None, \
+            "pending forward(); use update() for it first"
+        owner = self._fused_owner
+        trainer = self._fused_trainer
+        optm = self._optimizer
+        k = len(data_batches)
+        if (self._kvstore is not None
+                and getattr(self._kvstore, "_heartbeat", None) is not None):
+            self._kvstore._heartbeat.progress()
+        self._params_dirty = True
+
+        sharding = trainer.batch_sharding_stacked()
+        multiproc = getattr(self, "_fused_multiproc", False) or getattr(
+            owner, "_fused_multiproc", False)
+
+        def _put_stack(arrs):
+            stacked = np.stack([a.asnumpy() for a in arrs])
+            if multiproc:
+                import jax
+
+                return jax.make_array_from_process_local_data(
+                    sharding, stacked)
+            import jax
+
+            return jax.device_put(stacked, sharding)
+
+        batches = {}
+        for i, name in enumerate(self._data_names):
+            batches[name] = _put_stack([b.data[i] for b in data_batches])
+        if self._label_names and data_batches[0].label:
+            for i, name in enumerate(self._label_names):
+                batches[name] = _put_stack(
+                    [b.label[i] for b in data_batches])
+
+        # advance the schedule exactly as K update() calls would
+        lrs, ts = [], []
+        for _ in range(k):
+            owner._fused_t += 1
+            optm.num_update = max(owner._fused_t, optm.num_update)
+            lrs.append(optm.lr_scheduler(optm.num_update)
+                       if optm.lr_scheduler is not None else optm.lr)
+            ts.append(owner._fused_t)
+
+        if self is not owner and self._fused_params is None:
+            self._fused_params = owner._fused_params
+            self._fused_aux = owner._fused_aux
+            self._fused_opt = owner._fused_opt
+        p, a, s, outs = trainer.call_multi(
+            owner._fused_params, owner._fused_aux, owner._fused_opt,
+            batches, lrs, ts)
+        owner._fused_params, owner._fused_aux, owner._fused_opt = p, a, s
+        owner._fused_exec_stale = True
+        self._fused_exec_stale = True
+        self._fused_batch = None
+        # outs: stacked (K, rows, ...) per head; slice lazily per step
+        steps = [[o[i] for o in outs] for i in range(k)]
+        # leave the LAST step's outputs readable via get_outputs()
+        self._fused_outs_raw = steps[-1]
+        self._fused_outputs = None
+        return steps
+
     def _materialized_fused_outputs(self):
         if self._fused_outputs is None and self._fused_outs_raw is not None:
             self._fused_outputs = [

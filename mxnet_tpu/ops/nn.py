@@ -277,7 +277,15 @@ def _conv2d_wgrad_patches(data, weight, stride, pad, dilate):
     matmul — and accumulates in f32 via preferred_element_type, which
     the native bf16 wgrad conv does not guarantee. Exact same math;
     gated by MXNET_CONV_WGRAD=patches; numerics pinned in
-    tests/test_conv_bwd_layout.py."""
+    tests/test_conv_bwd_layout.py.
+
+    Memory: the patches tensor is (N, C*kh*kw, OH, OW) — ~kh*kw x the
+    activation footprint (9x for 3x3), which can exceed HBM at large
+    batch. MXNET_CONV_WGRAD_CHUNK=<k> splits the batch into k chunks
+    and lax.scan-accumulates the f32 partial wgrads, bounding the live
+    patches slab to N/k images at the cost of k smaller matmuls (same
+    math — the contraction over N is a sum and accumulation stays f32;
+    only f32 summation order differs)."""
 
     def plain(d, w):
         return jax.lax.conv_general_dilated(
@@ -292,27 +300,50 @@ def _conv2d_wgrad_patches(data, weight, stride, pad, dilate):
     def fwd(data, weight):
         return conv(data, weight), (data, weight)
 
+    def partial_wgrad(dd, gg, w):
+        """f32 (O, C*kh*kw) wgrad contribution of one batch chunk."""
+        if (w.shape[2:] == (1, 1) and tuple(stride) == (1, 1)
+                and tuple(pad) == (0, 0)):
+            patches = dd  # 1x1/s1: the receptive field IS the input
+        else:
+            patches = jax.lax.conv_general_dilated_patches(
+                dd, filter_shape=w.shape[2:], window_strides=stride,
+                padding=[(p, p) for p in pad], rhs_dilation=dilate,
+                dimension_numbers=_conv_dn(2))
+        # patches: (n, C*kh*kw, OH, OW) with feature order (c, kh, kw);
+        # gg: (n, O, OH, OW). Contract over (n, OH, OW) in ONE matmul.
+        ckk = patches.shape[1]
+        o = gg.shape[1]
+        p2 = jnp.transpose(patches, (1, 0, 2, 3)).reshape(ckk, -1)
+        g2 = jnp.transpose(gg, (1, 0, 2, 3)).reshape(o, -1)
+        return jax.lax.dot_general(
+            g2, p2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     def bwd(res, g):
         d, w = res
         _, dgrad_vjp = jax.vjp(lambda dd: plain(dd, w), d)
         gd, = dgrad_vjp(g)
-        if (w.shape[2:] == (1, 1) and tuple(stride) == (1, 1)
-                and tuple(pad) == (0, 0)):
-            patches = d  # 1x1/s1: the receptive field IS the input
+        n = d.shape[0]
+        try:
+            chunks = int(os.environ.get("MXNET_CONV_WGRAD_CHUNK", "1"))
+        except ValueError:
+            chunks = 1
+        if chunks > 1 and n % chunks == 0 and n // chunks >= 1:
+            ds = d.reshape((chunks, n // chunks) + d.shape[1:])
+            gs = g.reshape((chunks, n // chunks) + g.shape[1:])
+
+            def body(acc, dg):
+                dd, gg = dg
+                return acc + partial_wgrad(dd, gg, w), None
+
+            # C*kh*kw; equals C on the 1x1 fast path since kh=kw=1
+            ckk = w.shape[1] * w.shape[2] * w.shape[3]
+            gw, _ = jax.lax.scan(
+                body, jnp.zeros((w.shape[0], ckk), jnp.float32),
+                (ds, gs))
         else:
-            patches = jax.lax.conv_general_dilated_patches(
-                d, filter_shape=w.shape[2:], window_strides=stride,
-                padding=[(p, p) for p in pad], rhs_dilation=dilate,
-                dimension_numbers=_conv_dn(2))
-        # patches: (N, C*kh*kw, OH, OW) with feature order (c, kh, kw);
-        # g: (N, O, OH, OW). Contract over (N, OH, OW) in ONE matmul.
-        ckk = patches.shape[1]
-        o = g.shape[1]
-        p2 = jnp.transpose(patches, (1, 0, 2, 3)).reshape(ckk, -1)
-        g2 = jnp.transpose(g, (1, 0, 2, 3)).reshape(o, -1)
-        gw = jax.lax.dot_general(
-            g2, p2, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            gw = partial_wgrad(d, g, w)
         return gd, gw.astype(w.dtype).reshape(w.shape)
 
     conv.defvjp(fwd, bwd)

@@ -13,6 +13,8 @@ key sharding. Mesh axes follow the scaling-book convention:
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 
@@ -97,6 +99,17 @@ def allreduce_sum(value):
     value = np.asarray(value)
     if jax.process_count() <= 1:
         return value
+    # Bench/test knob: model a high-RTT interconnect by sleeping before
+    # the collective (benchmarks/dist_overlap_worker.py uses it to show
+    # what the comm engine's overlap buys when the network, not the CPU,
+    # is the bottleneck — on the 1-core CI box localhost gloo has ~zero
+    # latency, so without this the collective chain can never be hidden).
+    # The sleep releases the GIL like a real network wait would.
+    inj_ms = os.environ.get("MXNET_KVSTORE_INJECT_LATENCY_MS")
+    if inj_ms:
+        import time as _time
+
+        _time.sleep(float(inj_ms) / 1000.0)
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -103,6 +103,7 @@ class ShardedTrainStep:
         self.param_specs = dict(param_specs or {})
         self._batch_spec = P("dp")
         self._step = None
+        self._step_multi = {}  # K -> jitted K-step scan program
         self._creation_shapes_sig = None
         self._needs_rng = any(
             (not n.is_variable) and n.op.needs_rng
@@ -134,6 +135,14 @@ class ShardedTrainStep:
         from jax.sharding import NamedSharding
 
         return NamedSharding(self.mesh, self._batch_spec)
+
+    def batch_sharding_stacked(self):
+        """Sharding for a (K, batch, ...) stack of K step batches: the
+        scan axis is unsharded, rows shard over dp like batch_sharding."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(
+            self.mesh, P(*((None,) + tuple(self._batch_spec))))
 
     # ------------------------------------------------------------------
     def place_params(self, arg_arrays_by_name, aux_arrays_by_name):
@@ -269,13 +278,9 @@ class ShardedTrainStep:
             opt.num_update = saved_num_update
         return new_params, new_state
 
-    def compile(self, data_shapes_by_name=None):
-        """Build + jit the fused step fn. Returns self.
-
-        Shardings are NOT pinned here: inputs arrive committed (placed by
-        place_params/make_state/batch device_put) and GSPMD propagates —
-        the idiomatic "computation follows sharding" path; donation keeps
-        params/opt-state in place across steps."""
+    def _make_step_fn(self):
+        """The single-step fwd+bwd+psum+optimizer body (pure; shared by
+        the per-step jit and the K-step lax.scan program)."""
         import jax
         import jax.numpy as jnp
 
@@ -307,8 +312,89 @@ class ShardedTrainStep:
             new_aux = {**aux, **new_aux}  # carry shared-owner extras through
             return new_params, new_aux, new_opt, outs
 
-        self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
+
+    def compile(self, data_shapes_by_name=None):
+        """Build + jit the fused step fn. Returns self.
+
+        Shardings are NOT pinned here: inputs arrive committed (placed by
+        place_params/make_state/batch device_put) and GSPMD propagates —
+        the idiomatic "computation follows sharding" path; donation keeps
+        params/opt-state in place across steps."""
+        import jax
+
+        self._step = jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
         return self
+
+    def compile_multi(self, k):
+        """Jit a K-step program: lax.scan of the fused step over stacked
+        batches — ONE host dispatch per K optimizer steps.
+
+        Motivation (VERDICT r4 #3): on the tunneled v5e a b32 step pays
+        ~13.7 ms host dispatch against ~11.6 ms device time; scanning K
+        steps inside one XLA program amortizes the dispatch to 1/K per
+        step, the in-graph analog of the reference's dispatch-hiding
+        threaded engine (threaded_engine_perdevice.cc:26-136 — its
+        python thread never waits on the device). Exact same per-step
+        math: the scan body IS the single-step body; lr/t/rng arrive as
+        (K,)-stacked xs so schedules advance per micro-step.
+
+        Returns the jitted fn (params, aux, opt, batches[K,...],
+        rngs[K,2], lrs[K], ts[K]) -> (params, aux, opt, outs[K, ...]);
+        cached per K."""
+        import jax
+
+        fn = self._step_multi.get(k)
+        if fn is not None:
+            return fn
+        step = self._make_step_fn()
+
+        def multi(params, aux, opt_state, batches, rngs, lrs, ts):
+            def body(carry, xs):
+                p, a, s = carry
+                batch_k, rng_k, lr_k, t_k = xs
+                np_, na, ns, outs = step(p, a, s, batch_k, rng_k,
+                                         lr_k, t_k)
+                return (np_, na, ns), outs
+
+            (p, a, s), outs = jax.lax.scan(
+                body, (params, aux, opt_state), (batches, rngs, lrs, ts))
+            return p, a, s, outs
+
+        fn = jax.jit(multi, donate_argnums=(0, 1, 2))
+        self._step_multi[k] = fn
+        return fn
+
+    def call_multi(self, params, aux, opt_state, batches, lrs, ts):
+        """Run K fused steps in one dispatch (see compile_multi).
+
+        `batches`: dict name -> (K, batch, ...) arrays already placed
+        with batch_sharding_stacked(); `lrs`/`ts`: length-K sequences
+        (per-micro-step schedule values, host-computed)."""
+        import jax.numpy as jnp
+
+        k = len(lrs)
+        fn = self.compile_multi(k)
+        # creation-shape overrides depend only on the PER-STEP shapes
+        # (scan axis dropped), so the signature is shared with __call__
+        shapes = {n: tuple(v.shape) for n, v in params.items()}
+        shapes.update({n: tuple(v.shape[1:]) for n, v in batches.items()})
+        sig = tuple(sorted(shapes.items()))
+        if sig != self._creation_shapes_sig:
+            from ..executor import resolve_creation_shapes
+
+            self.program.shape_overrides = resolve_creation_shapes(
+                self.symbol, shapes)
+            self._creation_shapes_sig = sig
+        if self._needs_rng:
+            from .. import random as _random
+
+            rngs = jnp.stack([_random.next_key() for _ in range(k)])
+        else:
+            rngs = jnp.zeros((k, 2), jnp.uint32)
+        return fn(params, aux, opt_state, batches, rngs,
+                  jnp.asarray(lrs, jnp.float32),
+                  jnp.asarray(ts, jnp.float32))
 
     def __call__(self, params, aux, opt_state, batch, rng=None, lr=None, t=1):
         assert self._step is not None, "call compile() first"

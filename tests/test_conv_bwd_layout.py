@@ -359,3 +359,37 @@ def test_wgrad_patches_env_flag_routes_training_grads(monkeypatch):
     for n in g_off:
         np.testing.assert_allclose(g_off[n], g_on[n], rtol=1e-4,
                                    atol=1e-5, err_msg=n)
+
+
+@pytest.mark.parametrize("chunks", [2, 4])
+@pytest.mark.parametrize("dshape,wshape,stride,pad,dilate", [
+    ((4, 8, 14, 14), (16, 8, 3, 3), (1, 1), (1, 1), (1, 1)),
+    ((4, 8, 14, 14), (4, 8, 1, 1), (1, 1), (0, 0), (1, 1)),  # 1x1 fast path
+])
+def test_wgrad_patches_chunked_matches_unchunked(monkeypatch, chunks,
+                                                 dshape, wshape, stride,
+                                                 pad, dilate):
+    """MXNET_CONV_WGRAD_CHUNK=k: the lax.scan-accumulated chunked wgrad
+    must match the one-matmul wgrad (same math — the contraction over N
+    is a sum; tolerances cover f32 accumulation-order differences
+    between k partial dots and one long dot)."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(*dshape), jnp.float32)
+    w = jnp.asarray(rng.randn(*wshape), jnp.float32)
+
+    def run():
+        y, vjp = jax.vjp(
+            lambda x, w: nn._conv2d_wgrad_patches(x, w, stride, pad,
+                                                  dilate), x, w)
+        ct = jnp.asarray(np.random.RandomState(5).randn(*y.shape),
+                         jnp.float32)
+        return vjp(ct)
+
+    monkeypatch.delenv("MXNET_CONV_WGRAD_CHUNK", raising=False)
+    gx0, gw0 = run()
+    monkeypatch.setenv("MXNET_CONV_WGRAD_CHUNK", str(chunks))
+    gx1, gw1 = run()
+    np.testing.assert_allclose(np.asarray(gx0), np.asarray(gx1),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw0), np.asarray(gw1),
+                               rtol=1e-4, atol=1e-4)

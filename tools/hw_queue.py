@@ -165,11 +165,18 @@ def bench_lock_holder():
     directly); while the holder is alive the queue must not start jobs
     — two claimants contending for the tunnel can wedge the driver's
     round-end capture. A dead recorded pid (os._exit skips cleanup) is
-    ignored. The queue's own bench job is not a conflict: the lock
-    check happens between jobs, when that child has already exited."""
+    ignored; pid REUSE is handled by comparing the /proc start time
+    bench.py records in the lock ("pid:startticks") — a recycled pid
+    has a different start time, so a stale lock can't make the queue
+    sleep forever. Legacy pid-only locks fall back to an mtime bound.
+    The queue's own bench job is not a conflict: the lock check
+    happens between jobs, when that child has already exited."""
+    lock_path = os.path.join(REPO, ".bench_lock")
     try:
-        with open(os.path.join(REPO, ".bench_lock")) as f:
-            pid = int(f.read().strip() or 0)
+        with open(lock_path) as f:
+            raw = f.read().strip()
+        pid_s, _, ticks_s = raw.partition(":")
+        pid = int(pid_s or 0)
     except (OSError, ValueError):
         return None
     if pid <= 0:
@@ -178,7 +185,36 @@ def bench_lock_holder():
         os.kill(pid, 0)
     except OSError:
         return None
+    now_ticks = _proc_start_ticks(pid)
+    if ticks_s:
+        try:
+            if now_ticks is not None and int(ticks_s) != now_ticks:
+                return None  # pid recycled: not the recorded holder
+        except ValueError:
+            pass
+    else:
+        # legacy lock without a start time: distrust it after 2h — no
+        # bench run legitimately holds the tunnel that long.
+        try:
+            if time.time() - os.path.getmtime(lock_path) > 7200:
+                return None
+        except OSError:
+            return None
     return pid
+
+
+def _proc_start_ticks(pid):
+    """Start-time ticks of `pid` — bench.py's helper (the lock's writer
+    and this reader must parse /proc identically, so there is exactly
+    one implementation; see bench._proc_start_ticks)."""
+    try:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from bench import _proc_start_ticks as impl
+
+        return impl(pid)
+    except Exception:  # noqa: BLE001 — unparseable /proc or import issue
+        return None
 
 
 def next_job(jobs, retries):
@@ -231,6 +267,24 @@ def main(argv=None):
         log("running %s (attempt %d): %s"
             % (job["name"], job["attempts"], " ".join(job["argv"])))
         job.update(run_job(job))
+        # A DEADLINE_EXCEEDED can be a deterministic server-side compile
+        # deadline rather than a transient wedge (bench.is_tunnel_error
+        # can't tell them apart from the message alone). Two wedges in a
+        # row with that signature = deterministic: stop burning retry
+        # windows on it.
+        if job["status"] == "wedged":
+            if "deadline_exceeded" in (job.get("log_tail") or "").lower():
+                job["deadline_wedges"] = job.get("deadline_wedges", 0) + 1
+                if job["deadline_wedges"] >= 2:
+                    job["status"] = "failed"
+                    job["note"] = (
+                        "consecutive DEADLINE_EXCEEDED wedges: treating "
+                        "as deterministic compile deadline, not a wedge")
+            else:
+                # a different wedge signature breaks the consecutive
+                # run — one-off deadline blips must not accumulate into
+                # a permanent failure across unrelated retries
+                job.pop("deadline_wedges", None)
         update_job(args.state, job)
         log("%s -> %s (rc=%s, %.0fs)"
             % (job["name"], job["status"], job.get("rc"), job["wall_s"]))

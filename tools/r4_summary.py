@@ -76,8 +76,17 @@ def main():
                      + glob.glob(os.path.join(RES, "bench_live_*.json")),
                      key=os.path.getmtime)  # newest LAST across both schemes
     if benches:
-        print("== bench rows (newest: %s) ==" % os.path.basename(benches[-1]))
-        b = _load(benches[-1]) or {}
+        # Headline rule matches bench.recorded_hardware_result: the
+        # newest COMPLETE row set (has the bf16 large-batch row) beats a
+        # newer wedge-truncated partial; fall back to newest of any shape.
+        headline = next(
+            (p for p in reversed(benches)
+             if any(k.startswith("bf16_batch")
+                    and k.endswith("images_per_sec")
+                    for k in (_load(p) or {}))),
+            benches[-1])
+        print("== bench rows (headline: %s) ==" % os.path.basename(headline))
+        b = _load(headline) or {}
         for k in sorted(b):
             if k.endswith("mfu") and b[k] is not None:
                 print("  %-40s %.1f%%" % (k, 100 * b[k]))

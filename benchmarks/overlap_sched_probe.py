@@ -110,19 +110,31 @@ def analyze(hlo_text):
     async_pairs = 0
     sync_colls = 0
     windows = []
-    done_by_prefix = {i: (k, n) for i, k, n, _ in colls
-                      if k.endswith("-done")}
     for i, k, n, b in colls:
         if k.endswith("-done"):
             continue
-        total_bytes += b
         if k.endswith("-start"):
             async_pairs += 1
-            # find matching done: first -done after i whose operand
-            # references this start's name (cheap: next done of same op)
-            done_i = next((j for j, kk, _, _ in colls
-                           if j > i and kk == k.replace("-start", "-done")),
-                          None)
+            # matching done = the -done whose operand list references
+            # THIS start's name (overlapping same-kind starts make
+            # "next done of the same kind" pair wrongly: start A,
+            # start B, done A, done B would give B the window [B, doneA])
+            name = n.lstrip("%")
+            # (?![\w.]) = full-name match: %all-reduce-start must not
+            # pair with a done consuming %all-reduce-start.1
+            done_i = next(
+                (j for j, kk, _, _ in colls
+                 if kk == k.replace("-start", "-done")
+                 and re.search(r"\(\s*%?" + re.escape(name) + r"(?![\w.])",
+                               lines[j])),
+                None)
+            # payload bytes: the DONE's result shape (a start's printed
+            # shape is a tuple carrying operand aliases — counting it
+            # double-counts the transfer)
+            if done_i is not None:
+                dm = COLLECTIVE_RE.match(lines[done_i])
+                b = shape_bytes(dm.group(2)) if dm else b
+            total_bytes += b
             inside = sum(1 for ci in compute_idx
                          if done_i is not None and i < ci < done_i)
             windows.append({"start_line": i, "done_line": done_i,
@@ -131,6 +143,7 @@ def analyze(hlo_text):
                 overlappable_bytes += b
         elif k in sync_kinds:
             sync_colls += 1
+            total_bytes += b
             # a sync collective can still be followed by compute it
             # does NOT depend on only if the scheduler put compute
             # after it; count bytes as overlappable only in that case

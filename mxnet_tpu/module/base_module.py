@@ -192,8 +192,7 @@ class BaseModule(object):
                 if len(pending) == fit_k:
                     steps = self.update_multi([b for _, b in pending])
                     for (nbatch, db), outs in zip(pending, steps):
-                        self._fused_outs_raw = outs
-                        self._fused_outputs = None
+                        self._install_step_outputs(outs)
                         self.update_metric(eval_metric, db.label)
                         _fire(batch_end_callback, epoch, nbatch,
                               eval_metric, _cb_locals(nbatch, db))

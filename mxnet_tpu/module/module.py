@@ -683,9 +683,16 @@ class Module(BaseModule):
         # outs: stacked (K, rows, ...) per head; slice lazily per step
         steps = [[o[i] for o in outs] for i in range(k)]
         # leave the LAST step's outputs readable via get_outputs()
-        self._fused_outs_raw = steps[-1]
-        self._fused_outputs = None
+        self._install_step_outputs(steps[-1])
         return steps
+
+    def _install_step_outputs(self, outs_raw):
+        """Publish one micro-step's raw outputs as the current fused
+        outputs (fit's multi-step flush uses this per step so
+        update_metric/get_outputs serve that step's results — the
+        ONLY sanctioned way for callers to set fused-output state)."""
+        self._fused_outs_raw = outs_raw
+        self._fused_outputs = None
 
     def _materialized_fused_outputs(self):
         if self._fused_outputs is None and self._fused_outs_raw is not None:

@@ -114,3 +114,50 @@ def test_op_docs_fresh():
          "--check"],
         capture_output=True, text=True, timeout=240)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_launch_tracker_modes_dry_run(tmp_path, capsys, monkeypatch):
+    """mpi/sge/yarn trackers (reference dmlc tracker parity): --dry-run
+    emits a submission command wrapping the rank shim; the shim itself
+    must map every scheduler's rank variable onto the JAX/DMLC env
+    contract and exec the command."""
+    import launch
+
+    # sge/yarn write the shim into cwd (shared-filesystem contract)
+    monkeypatch.chdir(tmp_path)
+
+    for mode, fn, kw in (
+            ("mpi", launch.launch_mpi, {}),
+            ("sge", launch.launch_sge, {"queue": "batch.q"}),
+            ("yarn", launch.launch_yarn, {})):
+        rc = fn(3, ["python", "train.py"], dry_run=True, **kw)
+        assert rc == 0, mode
+        out = capsys.readouterr().out
+        shim = next(tok for tok in out.split()
+                    if "mxtpu_launch_" in tok).rstrip("'\"")
+        shim = shim.split("=")[-1]
+        body = open(shim).read()
+        assert "JAX_NUM_PROCESSES=\"3\"" in body, mode
+        assert "DMLC_NUM_WORKER=\"3\"" in body, mode
+        assert "exec python train.py" in body, mode
+        if mode == "sge":
+            assert "-t 1-3" in out
+            assert "-q batch.q" in out
+        if mode == "yarn":
+            assert "-num_containers 3" in out
+
+    # the shim's rank mapping, executed for real under each scheduler's
+    # env convention (mpi OMPI var; sge task id is 1-based)
+    echo = tmp_path / "echo_rank.sh"
+    echo.write_text("#!/bin/sh\necho rank=$DMLC_RANK\n")
+    echo.chmod(0o755)
+    shim = launch._write_rank_shim(4, "127.0.0.1:29500",
+                                   ["sh", str(echo)])
+    for envvar, value, want in (("OMPI_COMM_WORLD_RANK", "2", "rank=2"),
+                                ("SGE_TASK_ID", "3", "rank=2")):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("OMPI_COMM_WORLD_RANK", "SGE_TASK_ID")}
+        env[envvar] = value
+        r = subprocess.run(["sh", shim], capture_output=True, text=True,
+                           env=env, timeout=30)
+        assert r.stdout.strip() == want, (envvar, r.stdout, r.stderr)

@@ -17,6 +17,21 @@ through in-step XLA collectives over ICI/DCN. This launcher therefore:
   by the nightly dist tests.
 - ``ssh`` mode: prints/executes one ssh command per host from a
   hostfile, same env contract.
+- ``mpi`` mode: one ``mpirun``/``mpiexec`` invocation; ranks read
+  ``OMPI_COMM_WORLD_RANK``/``PMI_RANK`` and re-export the contract env
+  themselves via the generated wrapper (reference dmlc mpi tracker).
+- ``sge`` mode: emits + optionally ``qsub``s an array-job script
+  (one task per worker, ``SGE_TASK_ID`` → rank), coordinator = the
+  submit host (reference dmlc sge tracker).
+- ``yarn`` mode: emits a ``yarn``-cluster launch script using the
+  DistributedShell application (one container per worker,
+  ``CONTAINER_ID`` ordinal → rank). The reference's java tracker
+  managed a PS tier; with workers-only SPMD a shell-container launch
+  carries the whole contract.
+
+``mpi``/``sge``/``yarn`` require their schedulers on PATH; with
+``--dry-run`` each prints the exact submission artifact instead
+(testable anywhere, and what you paste into your cluster tooling).
 
 Worker code calls ``mxnet_tpu.parallel.init_distributed()`` (a thin
 ``jax.distributed.initialize`` wrapper reading this env).
@@ -130,13 +145,125 @@ def launch_ssh(hosts, num_workers, command, coordinator_port=29500,
     return rc
 
 
+_RANK_SHIM = r"""#!/bin/sh
+# generated by tools/launch.py: map the scheduler's rank variable onto
+# the JAX/DMLC distributed env contract, then exec the user command.
+RANK="${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-${PMIX_RANK:-${SLURM_PROCID:-0}}}}"
+if [ -n "$SGE_TASK_ID" ]; then RANK=$(($SGE_TASK_ID - 1)); fi
+export JAX_PROCESS_ID="$RANK"
+export JAX_NUM_PROCESSES="%(n)d"
+export JAX_COORDINATOR_ADDRESS="%(coord)s"
+export DMLC_ROLE=worker
+export DMLC_RANK="$RANK"
+export DMLC_NUM_WORKER="%(n)d"
+export DMLC_NUM_SERVER=0
+exec %(cmd)s
+"""
+
+
+def _write_rank_shim(num_workers, coordinator, command, shared=False):
+    """A scheduler-agnostic wrapper script: the scheduler provides the
+    rank (mpi/sge/slurm variable), the shim provides the contract env.
+    This replaces the reference tracker's per-role env injection — with
+    no PS tier every task is a worker and rank is all it needs.
+
+    shared=True writes into the job's cwd instead of node-local /tmp:
+    sge/yarn tasks execute on OTHER hosts, which see the submit dir via
+    the cluster's shared filesystem (the same assumption qsub -cwd and
+    the reference's dmlc tracker logs make) but never this node's /tmp."""
+    import shlex
+
+    if shared:
+        fd, path = tempfile.mkstemp(prefix="mxtpu_launch_", suffix=".sh",
+                                    dir=os.getcwd())
+    else:
+        fd, path = tempfile.mkstemp(prefix="mxtpu_launch_", suffix=".sh")
+    with os.fdopen(fd, "w") as f:
+        f.write(_RANK_SHIM % {
+            "n": num_workers, "coord": coordinator,
+            "cmd": " ".join(shlex.quote(c) for c in command)})
+    os.chmod(path, 0o755)
+    return path
+
+
+def _submit(cmd, tool, dry_run):
+    """Print (dry-run / tool missing) or execute a submission command."""
+    if dry_run or shutil.which(tool) is None:
+        print(" ".join(cmd))
+        if shutil.which(tool) is None and not dry_run:
+            print("%s not on PATH; dry-run output above" % tool,
+                  file=sys.stderr)
+            return 127
+        return 0
+    return subprocess.call(cmd)
+
+
+def launch_mpi(num_workers, command, coordinator_port=29500,
+               dry_run=False):
+    """Reference dmlc mpi tracker analog: one mpirun over N ranks."""
+    coordinator = "%s:%d" % (os.environ.get("MXTPU_COORD_HOST",
+                                            "127.0.0.1"), coordinator_port)
+    shim = _write_rank_shim(num_workers, coordinator, command)
+    tool = ("mpirun" if shutil.which("mpirun") else
+            "mpiexec" if shutil.which("mpiexec") else "mpirun")
+    return _submit([tool, "-np", str(num_workers), shim], tool, dry_run)
+
+
+def launch_sge(num_workers, command, coordinator_port=29500,
+               dry_run=False, queue=None):
+    """Reference dmlc sge tracker analog: an array job, one task per
+    worker (SGE_TASK_ID 1..N -> rank 0..N-1)."""
+    import socket
+
+    coordinator = "%s:%d" % (os.environ.get("MXTPU_COORD_HOST",
+                                            socket.gethostname()),
+                             coordinator_port)
+    shim = _write_rank_shim(num_workers, coordinator, command,
+                            shared=True)
+    cmd = ["qsub", "-terse", "-cwd", "-V", "-b", "y",
+           "-t", "1-%d" % num_workers]
+    if queue:
+        cmd += ["-q", queue]
+    cmd.append(shim)
+    return _submit(cmd, "qsub", dry_run)
+
+
+def launch_yarn(num_workers, command, coordinator_port=29500,
+                dry_run=False):
+    """Reference dmlc yarn tracker analog via DistributedShell: N
+    containers each running the rank shim (rank = container ordinal,
+    which the shim reads from CONTAINER_ID's trailing index)."""
+    import socket
+
+    coordinator = "%s:%d" % (os.environ.get("MXTPU_COORD_HOST",
+                                            socket.gethostname()),
+                             coordinator_port)
+    shim = _write_rank_shim(num_workers, coordinator, command,
+                            shared=True)
+    # CONTAINER_ID = container_<cluster>_<app>_<attempt>_<ordinal>;
+    # ordinal 1 is the AM, workers start at 2 -> rank = ordinal - 2.
+    # Ordinals are ZERO-PADDED (000008): strip the padding before the
+    # POSIX arithmetic or $((...)) parses them as (invalid) octal.
+    shell = ("ORD=${CONTAINER_ID##*_}; "
+             "ORD=${ORD#${ORD%%[!0]*}}; ORD=${ORD:-0}; "
+             "OMPI_COMM_WORLD_RANK=$((ORD - 2)) sh %s" % shim)
+    jar = os.environ.get(
+        "YARN_DSHELL_JAR",
+        "hadoop-yarn-applications-distributedshell.jar")
+    cmd = ["yarn", "jar", jar, "-jar", jar,
+           "-num_containers", str(num_workers),
+           "-shell_command", shell]
+    return _submit(cmd, "yarn", dry_run)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("-H", "--hostfile", default=None)
     p.add_argument("--launcher", default="local",
-                   choices=["local", "ssh"])
+                   choices=["local", "ssh", "mpi", "sge", "yarn"])
     p.add_argument("--port", type=int, default=29500)
+    p.add_argument("--queue", default=None, help="sge queue (-q)")
     p.add_argument("--dry-run", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -144,6 +271,15 @@ def main(argv=None):
         p.error("no command given")
     if args.launcher == "local":
         return launch_local(args.num_workers, args.command, args.port)
+    if args.launcher == "mpi":
+        return launch_mpi(args.num_workers, args.command, args.port,
+                          dry_run=args.dry_run)
+    if args.launcher == "sge":
+        return launch_sge(args.num_workers, args.command, args.port,
+                          dry_run=args.dry_run, queue=args.queue)
+    if args.launcher == "yarn":
+        return launch_yarn(args.num_workers, args.command, args.port,
+                           dry_run=args.dry_run)
     with open(args.hostfile) as f:
         hosts = [l.strip() for l in f if l.strip()]
     return launch_ssh(hosts, args.num_workers, args.command, args.port,

@@ -105,6 +105,12 @@ CANDIDATES = [
     ("wgrad+s2d_strided",
      {**OFF, "MXNET_CONV_WGRAD": "patches",
       "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
+    # wgrad decomposed per kernel tap: same FLOPs as patches, no
+    # kh*kw patches slab (ops/nn.py _conv2d_wgrad_taps)
+    ("wgrad_taps", {**OFF, "MXNET_CONV_WGRAD": "taps"}),
+    ("wgrad_taps+s2d",
+     {**OFF, "MXNET_CONV_WGRAD": "taps",
+      "MXNET_CONV_S2D": "1", "BENCH_STEM_S2D": "1"}),
 ]
 # Compiler-option probes (in-process per-compile XLA knobs; an
 # unsupported flag just lands as an error row). These explore

@@ -228,6 +228,8 @@ def main():
         out["backend"] += "TPU expectation)"
         out.update(analyze(txt))
     elif MODE == "tpu_aot":
+        import signal
+
         import bench
 
         import jax
@@ -235,6 +237,29 @@ def main():
         bench.enable_compile_cache(jax)
         from jax.experimental import topologies
 
+        # a topology query dials the tunnel; if it wedges mid-call the
+        # job must exit (3 = hw_queue's retryable wedge code) instead of
+        # hanging to the queue's SIGTERM and burning the whole window.
+        # The message carries 'deadline_exceeded' on purpose: if the
+        # SAME phase times out twice in a row, hw_queue's consecutive-
+        # deadline cap stops retrying a job that structurally can't fit
+        # its alarm budget.
+        phase = {"name": "topology query", "budget_s": 240}
+
+        def _alarm(signum, frame):
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "results",
+                "overlap_sched_%s_%s.json" % (MODE, TAG))
+            with open(path, "w") as f:
+                json.dump({"mode": MODE,
+                           "error": "deadline_exceeded: %s exceeded %ds "
+                                    "(tunnel wedge or over-budget)"
+                                    % (phase["name"], phase["budget_s"])},
+                          f, indent=1)
+            os._exit(3)
+
+        signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(phase["budget_s"])
         topo = None
         errors = {}
         for name, kw in (
@@ -247,17 +272,24 @@ def main():
                 out["topology"] = name or str(kw)
                 break
             except Exception as e:  # noqa: BLE001
+                if bench.is_tunnel_error(e):
+                    out["error"] = "tunnel wedge: %s" % str(e)[:200]
+                    errors[name or str(kw)] = out["error"]
+                    break
                 errors[name or str(kw)] = str(e)[:200]
         if topo is None:
-            out["error"] = "no topology description available"
+            out.setdefault("error", "no topology description available")
             out["attempts"] = errors
         else:
             from jax.sharding import Mesh
             import numpy as np
 
+            phase["name"], phase["budget_s"] = "AOT build+compile", 400
+            signal.alarm(400)  # fresh budget for the AOT build+compile
             mesh = Mesh(np.array(topo.devices).reshape(-1)[:8], ("dp",))
             lowered = build_step(jax, mesh)
             txt = lowered.compile().as_text()
+            signal.alarm(0)
             out["backend"] = "tpu v5e AOT (2x4 topology, compile only)"
             out.update(analyze(txt))
     else:
@@ -270,7 +302,9 @@ def main():
         json.dump(out, f, indent=1)
     print(json.dumps({k: v for k, v in out.items()
                       if k != "async_windows"}))
-    return 0 if "error" not in out else 1
+    if "error" not in out:
+        return 0
+    return 3 if "tunnel wedge" in str(out["error"]) else 1
 
 
 if __name__ == "__main__":

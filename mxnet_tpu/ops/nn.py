@@ -263,6 +263,38 @@ def _conv2d_bwd_nhwc(data, weight, stride, pad, dilate, groups):
     return conv(data, weight)
 
 
+def _conv2d_wgrad_custom(data, weight, stride, pad, dilate, wgrad_fn):
+    """Shared custom_vjp scaffold for the wgrad levers: forward and the
+    DATA gradient stay jax's own lowerings (vjp of the plain conv);
+    only the filter gradient is replaced by wgrad_fn(d, g, w) -> f32
+    array reshapeable to w.shape. Keeping one scaffold means a fix to
+    the dgrad construction or the cotangent dtype cast lands in every
+    lever at once."""
+
+    def plain(d, w):
+        return jax.lax.conv_general_dilated(
+            d, w, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=_conv_dn(2))
+
+    @jax.custom_vjp
+    def conv(data, weight):
+        return plain(data, weight)
+
+    def fwd(data, weight):
+        return conv(data, weight), (data, weight)
+
+    def bwd(res, g):
+        d, w = res
+        _, dgrad_vjp = jax.vjp(lambda dd: plain(dd, w), d)
+        gd, = dgrad_vjp(g)
+        gw = wgrad_fn(d, g, w)
+        return gd, gw.astype(w.dtype).reshape(w.shape)
+
+    conv.defvjp(fwd, bwd)
+    return conv(data, weight)
+
+
 def _conv2d_wgrad_patches(data, weight, stride, pad, dilate):
     """2-D conv (NCHW, groups=1) whose FILTER gradient is computed as an
     explicit patches x grad matmul instead of XLA's native
@@ -287,19 +319,6 @@ def _conv2d_wgrad_patches(data, weight, stride, pad, dilate):
     math — the contraction over N is a sum and accumulation stays f32;
     only f32 summation order differs)."""
 
-    def plain(d, w):
-        return jax.lax.conv_general_dilated(
-            d, w, window_strides=stride,
-            padding=[(p, p) for p in pad], rhs_dilation=dilate,
-            dimension_numbers=_conv_dn(2))
-
-    @jax.custom_vjp
-    def conv(data, weight):
-        return plain(data, weight)
-
-    def fwd(data, weight):
-        return conv(data, weight), (data, weight)
-
     def partial_wgrad(dd, gg, w):
         """f32 (O, C*kh*kw) wgrad contribution of one batch chunk."""
         if (w.shape[2:] == (1, 1) and tuple(stride) == (1, 1)
@@ -320,10 +339,7 @@ def _conv2d_wgrad_patches(data, weight, stride, pad, dilate):
             g2, p2, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    def bwd(res, g):
-        d, w = res
-        _, dgrad_vjp = jax.vjp(lambda dd: plain(dd, w), d)
-        gd, = dgrad_vjp(g)
+    def wgrad(d, g, w):
         n = d.shape[0]
         try:
             chunks = int(os.environ.get("MXNET_CONV_WGRAD_CHUNK", "1"))
@@ -342,12 +358,58 @@ def _conv2d_wgrad_patches(data, weight, stride, pad, dilate):
             gw, _ = jax.lax.scan(
                 body, jnp.zeros((w.shape[0], ckk), jnp.float32),
                 (ds, gs))
-        else:
-            gw = partial_wgrad(d, g, w)
-        return gd, gw.astype(w.dtype).reshape(w.shape)
+            return gw
+        return partial_wgrad(d, g, w)
 
-    conv.defvjp(fwd, bwd)
-    return conv(data, weight)
+    return _conv2d_wgrad_custom(data, weight, stride, pad, dilate, wgrad)
+
+
+def _conv2d_wgrad_taps(data, weight, stride, pad, dilate):
+    """2-D conv (NCHW, groups=1) whose FILTER gradient is computed as
+    kh*kw per-tap matmuls over shifted input views instead of XLA's
+    native conv-backprop-filter or the patches lever's one big matmul.
+
+    Rationale: the patches lever (_conv2d_wgrad_patches) hands the MXU
+    one large contraction but materializes a (N, C*kh*kw, OH, OW) slab
+    — kh*kw x the activation footprint, an HBM-bandwidth/capacity tax
+    the r4 advisor flagged at large batch. The same contraction
+    decomposes exactly by kernel tap:
+
+        gw[o,c,kh,kw] = sum_{n,oh,ow} g[n,o,oh,ow] *
+                        xpad[n,c, oh*s+kh*dh, ow*s+kw*dw]
+
+    i.e. kh*kw independent (O x C) dot_generals, each contracting the
+    SAME g against a strided view of the padded input — total FLOPs
+    identical to the single matmul, peak memory 1x the activation (the
+    strided slice is fusable), f32 accumulation via
+    preferred_element_type. Data gradient stays jax's own lowering.
+    Gated by MXNET_CONV_WGRAD=taps; numerics pinned in
+    tests/test_conv_bwd_layout.py."""
+
+    def wgrad(d, g, w):
+        o, c, kh, kw = w.shape
+        sh, sw = stride
+        dh, dw = dilate
+        oh, ow = g.shape[2], g.shape[3]
+        xpad = jnp.pad(d, ((0, 0), (0, 0),
+                           (pad[0], pad[0]), (pad[1], pad[1])))
+        taps = []
+        for ih in range(kh):
+            for iw in range(kw):
+                xs = jax.lax.slice(
+                    xpad,
+                    (0, 0, ih * dh, iw * dw),
+                    (d.shape[0], c,
+                     ih * dh + sh * (oh - 1) + 1,
+                     iw * dw + sw * (ow - 1) + 1),
+                    (1, 1, sh, sw))  # (N, C, OH, OW) view of this tap
+                taps.append(jax.lax.dot_general(
+                    g, xs,
+                    (((0, 2, 3), (0, 2, 3)), ((), ())),
+                    preferred_element_type=jnp.float32))  # (O, C)
+        return jnp.stack(taps, axis=-1)  # (O, C, kh*kw)
+
+    return _conv2d_wgrad_custom(data, weight, stride, pad, dilate, wgrad)
 
 
 def _conv2d_s2d_strided(data, weight, kernel, pad, groups):
@@ -452,6 +514,9 @@ def _convolution(attrs, ins, is_train):
     elif (nd == 2 and os.environ.get("MXNET_CONV_WGRAD") == "patches"
             and groups == 1):
         out = _conv2d_wgrad_patches(data, weight, stride, pad, dilate)
+    elif (nd == 2 and os.environ.get("MXNET_CONV_WGRAD") == "taps"
+            and groups == 1):
+        out = _conv2d_wgrad_taps(data, weight, stride, pad, dilate)
     else:
         # NOTE: no preferred_element_type here — the MXU accumulates bf16
         # matmuls in fp32 natively, and an explicit f32 output + cast

@@ -393,3 +393,76 @@ def test_wgrad_patches_chunked_matches_unchunked(monkeypatch, chunks,
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(gw0), np.asarray(gw1),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("dshape,wshape,stride,pad,dilate", WGRAD_CASES)
+def test_wgrad_taps_matches_default(dshape, wshape, stride, pad, dilate):
+    """MXNET_CONV_WGRAD=taps (ops/nn.py _conv2d_wgrad_taps): the
+    per-tap shifted-view matmul decomposition of the filter gradient
+    must equal XLA's native conv-backprop-filter on every groups=1
+    shape class ResNet-50 uses (same contraction split by kernel tap;
+    no patches slab), and the data gradient must be untouched."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*dshape), jnp.float32)
+    w = jnp.asarray(rng.randn(*wshape), jnp.float32)
+
+    def f_default(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=[(p, p) for p in pad],
+            rhs_dilation=dilate, dimension_numbers=nn._conv_dn(2))
+
+    y0, vjp0 = jax.vjp(f_default, x, w)
+    ct = jnp.asarray(rng.randn(*y0.shape), jnp.float32)
+    gx0, gw0 = vjp0(ct)
+    y1, vjp1 = jax.vjp(
+        lambda x, w: nn._conv2d_wgrad_taps(x, w, stride, pad, dilate),
+        x, w)
+    gx1, gw1 = vjp1(ct)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx0), np.asarray(gx1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw0), np.asarray(gw1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_wgrad_taps_env_flag_routes_training_grads(monkeypatch):
+    """Product path: executor grads with MXNET_CONV_WGRAD=taps on ==
+    off; grouped convs must fall back (gate is groups==1)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), num_group=2, name="c2")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 5, 16, 16).astype(np.float32)
+    lab = rng.randint(0, 3, 4).astype(np.float32)
+
+    def grads(flag):
+        if flag:
+            monkeypatch.setenv("MXNET_CONV_WGRAD", "taps")
+        else:
+            monkeypatch.delenv("MXNET_CONV_WGRAD", raising=False)
+        exe = net.simple_bind(ctx=mx.cpu(), data=(4, 5, 16, 16),
+                              softmax_label=(4,))
+        r = np.random.RandomState(7)
+        for n, a in sorted(exe.arg_dict.items()):
+            if n in ("data", "softmax_label"):
+                continue
+            a[:] = r.randn(*a.shape).astype(np.float32) * 0.1
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["softmax_label"][:] = lab
+        exe.forward(is_train=True)
+        exe.backward()
+        return {n: g.asnumpy() for n, g in exe.grad_dict.items()
+                if g is not None}
+
+    g_off = grads(False)
+    g_on = grads(True)
+    for n in g_off:
+        np.testing.assert_allclose(g_off[n], g_on[n], rtol=1e-4,
+                                   atol=1e-5, err_msg=n)

@@ -1,4 +1,4 @@
-"""Digest the round-4 hardware sweep results into one readable block.
+"""Digest the round-4/5 hardware sweep results into one readable block.
 
 Reads benchmarks/results/{hw_queue_state,conv_bwd_experiments_*,
 mirror_sweep_*,benchmark_score_*,transformer_bench_*,bench_r4_*,
@@ -72,9 +72,57 @@ def main():
               % (cache.get("best"), cache.get("env"),
                  cache.get("gain_vs_baseline"), cache.get("source")))
 
+    # round-5 evidence files (print whichever exist)
+    for name, label in (
+            ("fit_dispatch_v5e_r5.json", "fit dispatch A/B (K-step scan)"),
+            ("overlap_sched_cpu_r5.json", "overlap schedule (cpu pipeline)"),
+            ("overlap_sched_tpu_aot_r5.json", "overlap schedule (tpu AOT)"),
+            ("kvstore_overlap_r5.json", "kvstore overlap latency regime"),
+            ("input_pipeline_r5.json", "input pipeline / decode sizing"),
+            ("scaling_model_r5.json", "weak-scaling model")):
+        d = _load(os.path.join(RES, name))
+        if not d:
+            continue
+        print("== %s ==" % label)
+        if name.startswith("fit_dispatch"):
+            for r in d.get("rows", []):
+                print("  K=%-3s %s" % (
+                    r.get("k"), "%.1f img/s (%.2f ms)" % (
+                        r["images_per_sec"], r["step_ms"])
+                    if "images_per_sec" in r else r.get("error", "?")[:70]))
+            for k in sorted(d):
+                if k.startswith("speedup_"):
+                    print("  %s: %sx" % (k, d[k]))
+        elif name.startswith("overlap_sched"):
+            print("  async_pairs=%s sync=%s opportunity=%s%s" % (
+                d.get("collectives_async_pairs"),
+                d.get("collectives_sync"),
+                d.get("overlap_opportunity_coeff"),
+                " ERROR: %s" % d["error"][:60] if "error" in d else ""))
+        elif name.startswith("kvstore"):
+            s = d.get("summary", {})
+            print("  3ms: %sx  8ms: %sx  (bar %s met=%s)" % (
+                s.get("inject_3ms_speedup"), s.get("inject_8ms_speedup"),
+                s.get("bar"), s.get("met")))
+        elif name.startswith("input_pipeline"):
+            print("  %s img/s/core, %s cores visible -> %s cores for "
+                  "%s img/s appetite" % (
+                      d.get("decode_img_s_per_core"),
+                      d.get("host_cores_visible"),
+                      d.get("decode_cores_needed_for_chip"),
+                      d.get("chip_appetite_img_s")))
+        elif name.startswith("scaling_model"):
+            ev = d.get("overlap_evidence", {})
+            curve = d.get("curve") or [{}]
+            print("  eff256 floor=%s  evidence: %s" % (
+                curve[-1].get("eff_no_overlap"),
+                (ev.get("dependency_level") or {}).get(
+                    "finding", "n/a")[:90]))
+
     benches = sorted(glob.glob(os.path.join(RES, "bench_r4_*.json"))
+                     + glob.glob(os.path.join(RES, "bench_r5_*.json"))
                      + glob.glob(os.path.join(RES, "bench_live_*.json")),
-                     key=os.path.getmtime)  # newest LAST across both schemes
+                     key=os.path.getmtime)  # newest LAST across schemes
     if benches:
         # Headline rule matches bench.recorded_hardware_result: the
         # newest COMPLETE row set (has the bf16 large-batch row) beats a

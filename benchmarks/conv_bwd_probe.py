@@ -127,6 +127,22 @@ def build_pass(jax, jnp, pass_name, layout, dtype,
             _, vjp = jax.vjp(lambda xx: conv(xx, w0), x0)
             (gx,) = vjp(ct)
             return ct + eps * gx.mean().astype(dtype), None
+    elif pass_name in ("wgrad_patches", "wgrad_taps"):
+        # the wgrad LEVERS (ops/nn.py), per shape: vjp w.r.t. the
+        # weight routes through each lever's custom filter gradient.
+        # NCHW / symmetric pads / groups==1 only (the levers' own gate).
+        from mxnet_tpu.ops import nn as _nn
+
+        lever = (_nn._conv2d_wgrad_patches
+                 if pass_name == "wgrad_patches"
+                 else _nn._conv2d_wgrad_taps)
+        pad_ints = tuple(p[0] for p in pads)
+
+        def body(ct, _):
+            _, vjp = jax.vjp(
+                lambda ww: lever(x0, ww, stride, pad_ints, (1, 1)), w0)
+            (gw,) = vjp(ct)
+            return ct + eps * gw.mean().astype(dtype), None
     else:  # wgrad
         def body(ct, _):
             _, vjp = jax.vjp(lambda ww: conv(x0, ww), w0)
@@ -150,6 +166,75 @@ def time_pass(jax, jnp, fn, init):
     float(out.ravel()[0].astype(jnp.float32))
     dt = time.perf_counter() - t0
     return 1000.0 * dt / (REPS * SCAN_K)  # ms per single pass
+
+
+def _sweep_items(jax, jnp, items, dtypes, layouts, passes, rows, totals,
+                 flush=None):
+    """Measure every (config, dtype, layout, pass); appends to rows/
+    totals in place so a _TunnelDead abort keeps what landed; `flush`
+    (if given) persists the rows after EVERY measurement — the only
+    protection that survives a SIGKILL'd hung compile (a SIGTERM
+    handler never runs while the main thread is blocked in C)."""
+    for (dshape, wshape, stride, pad, groups), mult in items:
+        flops = conv_flops(dshape, wshape, stride, pad)
+        for dt_name, dt in dtypes:
+            for layout in layouts:
+                row_passes = passes
+                if (layout == "NCHW" and groups == 1
+                        and not any(isinstance(p, tuple) for p in pad)
+                        and os.environ.get("PROBE_WGRAD_LEVERS") == "1"):
+                    # per-shape lever comparison (one extra compile per
+                    # lever per shape — opt-in to keep the default
+                    # sweep's tunnel budget unchanged)
+                    row_passes = passes + ("wgrad_patches", "wgrad_taps")
+                for p in row_passes:
+                    fn, init = build_pass(
+                        jax, jnp, p, layout, dt,
+                        dshape, wshape, stride, pad, groups)
+                    try:
+                        ms = time_pass(jax, jnp, fn, init)
+                    except Exception as e:  # noqa: BLE001 — record, keep going
+                        _check_wedge(e)
+                        rows.append({"dshape": dshape, "wshape": wshape,
+                                     "pass": p, "layout": layout,
+                                     "dtype": dt_name, "error": str(e)[:200]})
+                        continue
+                    tf = flops / (ms / 1000.0) / 1e12
+                    rows.append({
+                        "dshape": list(dshape), "wshape": list(wshape),
+                        "stride": list(stride), "pad": list(pad),
+                        "mult": mult, "pass": p, "layout": layout,
+                        "dtype": dt_name, "ms": round(ms, 3),
+                        "tflops": round(tf, 1),
+                        "pct_peak": round(100 * tf / PEAK_TFLOPS, 1),
+                    })
+                    key = (dt_name, layout, p)
+                    totals[key] = totals.get(key, 0.0) + ms * mult
+                    print("%-28s %-5s %-5s %-4s %8.3f ms  %6.1f TF/s "
+                          "(%4.1f%%) x%d"
+                          % (str(dshape), dt_name, layout, p, ms, tf,
+                             100 * tf / PEAK_TFLOPS, mult),
+                          file=sys.stderr)
+                    if flush is not None:
+                        flush()
+
+
+class _TunnelDead(RuntimeError):
+    """Raised mid-sweep when a measurement error matches the tunnel-
+    wedge signature: every later compile would hang too, so the sweep
+    must emit what it has and exit 3 (hw_queue's retryable code)
+    instead of burning the whole job timeout (the r4 NHWC lesson)."""
+
+
+def _is_wedge(e):
+    import bench
+
+    return isinstance(e, bench.TunnelWedgeError) or bench.is_tunnel_error(e)
+
+
+def _check_wedge(e):
+    if _is_wedge(e):
+        raise _TunnelDead(str(e)[:300]) from e
 
 
 def main():
@@ -190,35 +275,57 @@ def main():
                                 for k, m in items)),
               file=sys.stderr)
         items = items[:top]
-    for (dshape, wshape, stride, pad, groups), mult in items:
-        flops = conv_flops(dshape, wshape, stride, pad)
-        for dt_name, dt in dtypes:
-            for layout in layouts:
-                for p in passes:
-                    fn, init = build_pass(
-                        jax, jnp, p, layout, dt,
-                        dshape, wshape, stride, pad, groups)
-                    try:
-                        ms = time_pass(jax, jnp, fn, init)
-                    except Exception as e:  # noqa: BLE001 — record, keep going
-                        rows.append({"dshape": dshape, "wshape": wshape,
-                                     "pass": p, "layout": layout,
-                                     "dtype": dt_name, "error": str(e)[:200]})
-                        continue
-                    tf = flops / (ms / 1000.0) / 1e12
-                    rows.append({
-                        "dshape": list(dshape), "wshape": list(wshape),
-                        "stride": list(stride), "pad": list(pad),
-                        "mult": mult, "pass": p, "layout": layout,
-                        "dtype": dt_name, "ms": round(ms, 3),
-                        "tflops": round(tf, 1),
-                        "pct_peak": round(100 * tf / PEAK_TFLOPS, 1),
-                    })
-                    key = (dt_name, layout, p)
-                    totals[key] = totals.get(key, 0.0) + ms * mult
-                    print("%-28s %-5s %-5s %-4s %8.3f ms  %6.1f TF/s (%4.1f%%) x%d"
-                          % (str(dshape), dt_name, layout, p, ms, tf,
-                             100 * tf / PEAK_TFLOPS, mult), file=sys.stderr)
+    # a queue-timeout SIGTERM must not lose everything measured so far
+    # (the exit-3 wedge path only covers errors the process itself sees)
+    import signal as _signal
+
+    def _on_term(signum, frame):
+        snap = {
+            "batch": BATCH, "scan_k": SCAN_K,
+            "platform": dev.platform,
+            "configs_total": len(configs),
+            "configs_measured": len(items),
+            "rows": rows,
+            "partial_reason": "SIGTERM (queue timeout) mid-sweep",
+        }
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "results", "conv_bwd_probe_%s.json" % tag)
+        try:
+            with open(path, "w") as f:
+                json.dump(snap, f, indent=1)
+        finally:
+            os._exit(3)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # non-main thread (tests)
+
+    result_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "conv_bwd_probe_%s.json" % tag)
+
+    def _flush_rows():
+        snap = {
+            "batch": BATCH, "scan_k": SCAN_K,
+            "platform": dev.platform,
+            "configs_total": len(configs),
+            "configs_measured": len(items),
+            "rows": rows,
+            "partial_reason": "in progress (incremental flush; a "
+                              "complete run overwrites this)",
+        }
+        tmp = result_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=1)
+        os.replace(tmp, result_path)
+
+    partial_reason = None
+    try:
+        _sweep_items(jax, jnp, items, dtypes, layouts, passes, rows,
+                     totals, flush=_flush_rows)
+    except _TunnelDead as td:
+        partial_reason = "tunnel wedge mid-sweep: %s" % td
 
     # Stem space-to-depth experiment (MLPerf resnet-on-TPU trick): the
     # 7x7/s2 conv on C=3 wastes the MXU's 128 lanes; reshaping input
@@ -226,7 +333,7 @@ def main():
     # kernel 7x7 -> 8x8 gives the mathematically equivalent 4x4/s1 conv
     # on C=12. Time both stems in every pass to see what the swap buys.
     s2d_rows = []
-    for p in passes:
+    for p in (() if partial_reason else passes):
         for label, dshape, wshape, stride, pad in (
             ("stem_std", (BATCH, 3, 224, 224), (64, 3, 7, 7),
              (2, 2), (3, 3)),
@@ -247,8 +354,14 @@ def main():
                 print("%-9s %-5s %8.3f ms" % (label, p, ms),
                       file=sys.stderr)
             except Exception as e:  # noqa: BLE001
+                if _is_wedge(e):
+                    partial_reason = ("tunnel wedge in s2d rows: %s"
+                                      % str(e)[:300])
+                    break
                 s2d_rows.append({"exp": label, "pass": p,
                                  "error": str(e)[:160]})
+        if partial_reason:
+            break
 
     summary = {
         "%s_%s_%s_total_ms" % k: round(v, 2) for k, v in totals.items()
@@ -257,15 +370,29 @@ def main():
         "batch": BATCH, "scan_k": SCAN_K, "reps": REPS,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
+        # coverage stamps: without these a PROBE_TOP-truncated sweep's
+        # summary_weighted_ms silently reads as exhaustive (the stderr
+        # warning is lost to hw_queue's log-tail truncation)
+        "configs_total": len(configs),
+        "configs_measured": len(items),
+        "probe_top": top or None,
+        "wgrad_lever_passes":
+            os.environ.get("PROBE_WGRAD_LEVERS") == "1",
         "summary_weighted_ms": summary,
         "stem_space_to_depth": s2d_rows,
         "rows": rows,
     }
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "results", "conv_bwd_probe_%s.json" % tag)
-    with open(path, "w") as f:
+    if partial_reason:
+        out["partial_reason"] = partial_reason
+    try:  # measurements done: a late SIGTERM must not clobber the
+        _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)  # full write
+    except (ValueError, OSError):
+        pass
+    with open(result_path, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({"written": path, **summary}))
+    print(json.dumps({"written": result_path, **summary}))
+    if partial_reason:
+        sys.exit(3)  # hw_queue reschedules; rows measured so far are saved
 
 
 if __name__ == "__main__":

@@ -1,0 +1,280 @@
+"""tools/caffe_converter: self-contained Caffe -> mxnet_tpu conversion
+(reference tools/caffe_converter/{convert_symbol,convert_model}.py —
+which need caffe importable; ours parses the protobuf wire/text formats
+directly, so it must be validated against independently-encoded bytes).
+
+The test hand-encodes a .caffemodel with its own minimal protobuf
+writer (varints, length-delimited messages, packed floats — the wire
+spec, not shared code with the converter's reader) and uses torch as
+the numerical oracle: caffe semantics map onto
+conv2d / max_pool2d(ceil_mode=True) / batch_norm / linear / softmax.
+"""
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import mxnet_tpu as mx
+import caffe_converter as cc
+
+
+# --- minimal protobuf wire writer (test-side, independent of the reader) ---
+
+def _varint(x):
+    out = b""
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field, wtype):
+    return _varint((field << 3) | wtype)
+
+
+def _ld(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _s(field, text):
+    return _ld(field, text.encode())
+
+
+def _packed_f32(field, values):
+    return _ld(field, struct.pack("<%df" % len(values),
+                                  *[float(v) for v in values]))
+
+
+def _packed_i64(field, values):
+    return _ld(field, b"".join(_varint(int(v)) for v in values))
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    shape = _ld(7, _packed_i64(1, arr.shape))
+    return shape + _packed_f32(5, arr.reshape(-1))
+
+
+def _layer(name, ltype, blobs=()):
+    payload = _s(1, name) + _s(2, ltype)
+    for b in blobs:
+        payload += _ld(7, _blob(b))
+    return _ld(100, payload)  # NetParameter.layer
+
+
+PROTOTXT = """
+name: "tiny"
+input: "data"
+input_dim: 2 input_dim: 3 input_dim: 8 input_dim: 8
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "pool1" top: "bn1"
+  batch_norm_param { use_global_stats: true eps: 1e-5 } }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1"
+  scale_param { bias_term: true } }
+layer { name: "fc1" type: "InnerProduct" bottom: "bn1" top: "fc1"
+  inner_product_param { num_output: 5 } }
+layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+"""
+
+
+@pytest.fixture
+def tiny_model(tmp_path):
+    rng = np.random.RandomState(0)
+    w_conv = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3
+    b_conv = rng.randn(4).astype(np.float32) * 0.1
+    bn_mean = rng.randn(4).astype(np.float32) * 0.2
+    bn_var = (rng.rand(4).astype(np.float32) + 0.5)
+    bn_scale = np.asarray([2.0], np.float32)  # caffe stores mean*factor
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32) * 0.1
+    # pool1 of 8x8 with k3/s2 ceil-mode -> 4x4 spatial
+    w_fc = rng.randn(5, 4 * 4 * 4).astype(np.float32) * 0.1
+    b_fc = rng.randn(5).astype(np.float32) * 0.1
+
+    net = (_s(1, "tiny")
+           + _layer("conv1", "Convolution", [w_conv, b_conv])
+           + _layer("bn1", "BatchNorm",
+                    [bn_mean * bn_scale[0], bn_var * bn_scale[0],
+                     bn_scale])
+           + _layer("scale1", "Scale", [gamma, beta])
+           + _layer("fc1", "InnerProduct", [w_fc, b_fc]))
+    prototxt = tmp_path / "tiny.prototxt"
+    prototxt.write_text(PROTOTXT)
+    caffemodel = tmp_path / "tiny.caffemodel"
+    caffemodel.write_bytes(net)
+    weights = dict(w_conv=w_conv, b_conv=b_conv, bn_mean=bn_mean,
+                   bn_var=bn_var, gamma=gamma, beta=beta, w_fc=w_fc,
+                   b_fc=b_fc)
+    return str(prototxt), str(caffemodel), weights
+
+
+def _torch_forward(x, w):
+    import torch
+    import torch.nn.functional as F
+
+    t = torch.from_numpy(x)
+    t = F.conv2d(t, torch.from_numpy(w["w_conv"]),
+                 torch.from_numpy(w["b_conv"]), padding=1)
+    t = F.relu(t)
+    t = F.max_pool2d(t, 3, stride=2, ceil_mode=True)  # caffe convention
+    t = F.batch_norm(t, torch.from_numpy(w["bn_mean"]),
+                     torch.from_numpy(w["bn_var"]),
+                     torch.from_numpy(w["gamma"]),
+                     torch.from_numpy(w["beta"]), training=False,
+                     eps=1e-5)
+    t = F.linear(t.reshape(t.shape[0], -1), torch.from_numpy(w["w_fc"]),
+                 torch.from_numpy(w["b_fc"]))
+    return F.softmax(t, dim=1).numpy()
+
+
+def test_convert_model_matches_torch_oracle(tiny_model, tmp_path):
+    prototxt, caffemodel, w = tiny_model
+    prefix = str(tmp_path / "converted")
+    sym, args, auxs = cc.convert_model(prototxt, caffemodel, prefix)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0000.params")
+
+    # the Scale layer folded into bn1's gamma/beta; the stored caffe
+    # mean/var were scaled by the factor blob and must be unscaled
+    np.testing.assert_allclose(args["bn1_gamma"].asnumpy(), w["gamma"])
+    np.testing.assert_allclose(auxs["bn1_moving_mean"].asnumpy(),
+                               w["bn_mean"], rtol=1e-6)
+
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8))
+    for k, v in args.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    for k, v in auxs.items():
+        exe.aux_dict[k][:] = v.asnumpy()
+    x = np.random.RandomState(7).randn(2, 3, 8, 8).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    got = exe.forward(is_train=False)[0].asnumpy()
+    want = _torch_forward(x, w)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_converted_checkpoint_loads_as_module(tiny_model, tmp_path):
+    prototxt, caffemodel, w = tiny_model
+    prefix = str(tmp_path / "ckpt")
+    cc.convert_model(prototxt, caffemodel, prefix)
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+    mod = mx.mod.Module(sym, label_names=[])
+    mod.bind(data_shapes=[("data", (2, 3, 8, 8))], for_training=False)
+    mod.set_params(args, auxs)
+    x = np.random.RandomState(7).randn(2, 3, 8, 8).astype(np.float32)
+    mod.forward(mx.io.DataBatch([mx.nd.array(x)], []))
+    out = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, _torch_forward(x, w),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_v1_binary_layers_normalize(tmp_path):
+    """Legacy V1 'layers' (NetParameter field 2; V1LayerParameter
+    name=4 / type=5 enum / blobs=6) parse into the same normalized
+    BinLayer form the modern format yields."""
+    w = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    v1_layer = (_s(4, "conv1") + _tag(5, 0) + _varint(4)  # CONVOLUTION
+                + _ld(6, _blob(w)))
+    net = _s(1, "old") + _ld(2, v1_layer)
+    p = tmp_path / "old.caffemodel"
+    p.write_bytes(net)
+    layers = cc.parse_caffemodel(str(p))
+    assert [(l.name, l.type) for l in layers] == [("conv1", "Convolution")]
+    np.testing.assert_array_equal(layers[0].blobs[0], w)
+
+
+def test_v1_prototxt_normalizes():
+    proto = cc.parse_prototxt("""
+    name: "old"
+    input: "data" input_dim: 1 input_dim: 3 input_dim: 4 input_dim: 4
+    layers { name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+      convolution_param { num_output: 2 kernel_size: 3 } }
+    layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+    layers { name: "loss" type: SOFTMAX_LOSS bottom: "conv1" top: "loss" }
+    """)
+    layers = cc._proto_layers(proto)
+    assert [l["type"][-1] for l in layers] == [
+        "Convolution", "ReLU", "SoftmaxWithLoss"]
+
+
+def test_convert_mean_roundtrip(tmp_path):
+    mean = np.random.RandomState(0).rand(3, 4, 4).astype(np.float32)
+    p = tmp_path / "mean.binaryproto"
+    p.write_bytes(_blob(mean))
+    nd = cc.convert_mean(str(p), str(tmp_path / "mean.nd"))
+    np.testing.assert_allclose(nd.asnumpy(), mean)
+    loaded = mx.nd.load(str(tmp_path / "mean.nd"))["mean_image"]
+    np.testing.assert_allclose(loaded.asnumpy(), mean)
+
+
+def test_prototxt_parser_roundtrips_structure():
+    proto = cc.parse_prototxt(PROTOTXT)
+    assert proto["name"][-1] == "tiny"
+    assert [int(d) for d in proto["input_dim"]] == [2, 3, 8, 8]
+    layers = proto["layer"]
+    assert [l["type"][-1] for l in layers] == [
+        "Convolution", "ReLU", "Pooling", "BatchNorm", "Scale",
+        "InnerProduct", "Softmax"]
+    assert layers[0]["convolution_param"][-1]["num_output"][-1] == 4
+
+
+def test_repeated_per_axis_params():
+    """caffe's `repeated uint32` conv params: two entries mean (h, w),
+    one means square, explicit _h/_w win."""
+    proto = cc.parse_prototxt("""
+    layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+      convolution_param { num_output: 2
+        kernel_size: 3 kernel_size: 2
+        stride: 2 stride: 1
+        pad: 1 pad: 0 } }
+    """)
+    p = proto["layer"][0]["convolution_param"][-1]
+    assert cc._xy(p, "kernel_size", "kernel_h", "kernel_w", None) == (3, 2)
+    assert cc._xy(p, "stride", "stride_h", "stride_w", (1, 1)) == (2, 1)
+    assert cc._xy(p, "pad", "pad_h", "pad_w", (0, 0)) == (1, 0)
+    p2 = cc.parse_prototxt(
+        'p { kernel_size: 3 kernel_h: 5 kernel_w: 4 }')["p"][-1]
+    assert cc._xy(p2, "kernel_size", "kernel_h", "kernel_w", None) == (5, 4)
+
+
+def test_scale_pairs_by_topology_not_file_order(tmp_path):
+    """Two BNs then one Scale consuming the FIRST BN's top: the folded
+    gamma/beta must land on bn_a (topology), not bn_b (file order)."""
+    prototxt = tmp_path / "two_bn.prototxt"
+    prototxt.write_text("""
+    name: "twobn"
+    input: "data" input_dim: 1 input_dim: 2 input_dim: 4 input_dim: 4
+    layer { name: "bn_a" type: "BatchNorm" bottom: "data" top: "a"
+      batch_norm_param { use_global_stats: true } }
+    layer { name: "bn_b" type: "BatchNorm" bottom: "a" top: "b"
+      batch_norm_param { use_global_stats: true } }
+    layer { name: "sc" type: "Scale" bottom: "a" top: "a2"
+      scale_param { bias_term: true } }
+    """)
+    # NOTE: caffe graphs are dataflow; 'sc' reads blob "a" (bn_a's top)
+    gamma = np.asarray([2.0, 3.0], np.float32)
+    beta = np.asarray([0.5, -0.5], np.float32)
+    zeros2 = np.zeros(2, np.float32)
+    ones2 = np.ones(2, np.float32)
+    one = np.ones(1, np.float32)
+    net = (_s(1, "twobn")
+           + _layer("bn_a", "BatchNorm", [zeros2, ones2, one])
+           + _layer("bn_b", "BatchNorm", [zeros2, ones2, one])
+           + _layer("sc", "Scale", [gamma, beta]))
+    caffemodel = tmp_path / "two_bn.caffemodel"
+    caffemodel.write_bytes(net)
+    _, args, _ = cc.convert_model(str(prototxt), str(caffemodel))
+    np.testing.assert_allclose(args["bn_a_gamma"].asnumpy(), gamma)
+    np.testing.assert_allclose(args["bn_a_beta"].asnumpy(), beta)
+    np.testing.assert_allclose(args["bn_b_gamma"].asnumpy(), ones2)

@@ -180,11 +180,21 @@ class Perplexity(EvalMetric):
 
 class _Regression(EvalMetric):
     """Shared shape handling for elementwise regression metrics: a 1-d
-    label broadcasts against (N, 1) predictions, one score per batch."""
+    label aligns against (N, 1) predictions (the reference's
+    column-vector regression convention), one score per batch.
+
+    A 1-d PREDICTION is columnized too: without that, (N,1) label minus
+    (N,) pred broadcasts to an (N,N) all-pairs matrix and the metric
+    silently reports ~2x the label variance regardless of fit — found
+    via examples/matrix_factorization.py, whose scalar-dot predictions
+    are 1-d (the reference shares the label reshape but its examples
+    always emit (N,1) FC predictions, hiding the hazard)."""
 
     def _score(self, label, pred):
         if label.ndim == 1:
             label = label[:, None]
+        if pred.ndim == 1:
+            pred = pred[:, None]
         return float(self._agg(label, pred)), 1
 
 

@@ -49,3 +49,22 @@ def test_autoencoder_reconstructs():
     below input variance (the script asserts mse < 50% of variance)."""
     out = _run_example("autoencoder.py", "--num-epochs", "3")
     assert "reconstruction mse" in out
+
+
+def test_matrix_factorization_recovers_low_rank():
+    """examples/matrix_factorization.py (reference example/recommenders):
+    embedding-dot regression must recover synthetic low-rank structure
+    (script asserts mse < 20% of rating variance). Also a regression
+    canary for the 1-d-prediction MSE metric fix."""
+    out = _run_example("matrix_factorization.py", "--num-epochs", "8")
+    assert "rating mse" in out
+
+
+def test_bi_lstm_sort_learns():
+    """examples/bi_lstm_sort.py (reference example/bi-lstm-sort): the
+    BidirectionalCell unroll must train end to end; short smoke run
+    only requires clearly-above-chance per-digit accuracy (full config
+    reaches ~0.96)."""
+    out = _run_example("bi_lstm_sort.py", "--num-epochs", "3",
+                       "--num-samples", "1500", "--min-acc", "0.3")
+    assert "per-digit sort accuracy" in out

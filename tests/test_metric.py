@@ -68,6 +68,22 @@ def test_regression_metrics():
     assert abs(rmse.get()[1] - math.sqrt((0.25 + 0 + 1) / 3)) < 1e-6
 
 
+def test_regression_metrics_1d_predictions():
+    """1-d predictions (scalar-dot heads like matrix factorization)
+    must score identically to the (N,1) column convention: the old
+    code columnized only the LABEL, so (N,1)-(N,) broadcast to an
+    (N,N) all-pairs matrix and the metric reported ~2x label variance
+    regardless of fit."""
+    label = [1.0, 2.0, 3.0]
+    pred_1d = [1.5, 2.0, 2.0]
+    mse = mx.metric.MSE()
+    mse.update([_nd(label)], [_nd(pred_1d)])
+    assert abs(mse.get()[1] - (0.25 + 0 + 1) / 3) < 1e-6
+    mae = mx.metric.MAE()
+    mae.update([_nd(label)], [_nd(pred_1d)])
+    assert abs(mae.get()[1] - 0.5) < 1e-6
+
+
 def test_cross_entropy():
     m = mx.metric.CrossEntropy(eps=0.0)
     probs = [[0.25, 0.75], [0.5, 0.5]]

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Multi-task training: one trunk, two loss heads (parity: reference
+example/multi-task — digit class + a second task trained jointly from
+a shared representation via a Group symbol).
+
+Tasks on sklearn digits: head A classifies the digit (10-way), head B
+its parity (2-way). Exercises multi-output Modules end to end: Group
+loss heads, multiple label_names, per-head gradients summing into the
+shared trunk, and per-head evaluation.
+
+Run:  python examples/multi_task.py [--ctx cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from common import add_fit_args, get_context
+import mxnet_tpu as mx
+
+
+def build_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    digit = mx.sym.FullyConnected(net, num_hidden=10, name="digit_fc")
+    digit = mx.sym.SoftmaxOutput(digit, mx.sym.Variable("digit_label"),
+                                 name="digit")
+    parity = mx.sym.FullyConnected(net, num_hidden=2, name="parity_fc")
+    parity = mx.sym.SoftmaxOutput(parity,
+                                  mx.sym.Variable("parity_label"),
+                                  name="parity")
+    return mx.sym.Group([digit, parity])
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(p)
+    p.set_defaults(num_epochs=15, batch_size=50, lr=0.1)
+    args = p.parse_args()
+    ctx = get_context(args)
+
+    from sklearn.datasets import load_digits
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    d = load_digits()
+    X = (d.images / 16.0).astype(np.float32).reshape(-1, 64)
+    y = d.target.astype(np.float32)
+    n = 1500
+    it = mx.io.NDArrayIter(
+        X[:n], {"digit_label": y[:n], "parity_label": y[:n] % 2},
+        batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(
+        X[n:], {"digit_label": y[n:], "parity_label": y[n:] % 2},
+        batch_size=args.batch_size)
+
+    mod = mx.mod.Module(build_sym(), context=ctx,
+                        label_names=["digit_label", "parity_label"])
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            num_epoch=args.num_epochs)
+
+    # per-head validation accuracy
+    val.reset()
+    hits = np.zeros(2)
+    count = 0
+    for b in val:
+        mod.forward(b, is_train=False)
+        # drop the iterator's wrap-around pad rows (duplicated samples
+        # would bias the accuracy denominators)
+        keep = b.data[0].shape[0] - getattr(b, "pad", 0)
+        outs = [o.asnumpy()[:keep] for o in mod.get_outputs()]
+        labs = [l.asnumpy()[:keep] for l in b.label]
+        hits[0] += (outs[0].argmax(1) == labs[0]).sum()
+        hits[1] += (outs[1].argmax(1) == labs[1]).sum()
+        count += keep
+    acc_digit, acc_parity = hits / count
+    print("digit accuracy : %.3f" % acc_digit)
+    print("parity accuracy: %.3f" % acc_parity)
+    bar = 0.85 if args.num_epochs < 12 else 0.90
+    assert acc_digit >= bar, acc_digit
+    assert acc_parity >= bar, acc_parity
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,8 +91,13 @@ class EvalMetric(object):
 
 def _as_class_ids(label, pred):
     """Hard class ids from (label, pred): argmax pred over the channel
-    axis when it still carries probabilities."""
-    pred_ids = pred if pred.shape == label.shape else pred.argmax(axis=1)
+    axis when it still carries probabilities. Probabilities are
+    detected by SIZE, not exact shape: an (N,1)-vs-(N,) layout skew
+    (DataIter column labels + id predictions) must not be mistaken for
+    an (N,C) probability matrix — the old shape!=shape test sent (N,)
+    id predictions into argmax(axis=1) and crashed."""
+    pred_ids = (pred if pred.size == label.size
+                else pred.argmax(axis=1))
     return label.astype("int64").ravel(), pred_ids.astype("int64").ravel()
 
 

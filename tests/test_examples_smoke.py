@@ -68,3 +68,11 @@ def test_bi_lstm_sort_learns():
     out = _run_example("bi_lstm_sort.py", "--num-epochs", "3",
                        "--num-samples", "1500", "--min-acc", "0.3")
     assert "per-digit sort accuracy" in out
+
+
+def test_multi_task_both_heads_learn():
+    """examples/multi_task.py (reference example/multi-task): a Group
+    of two loss heads over a shared trunk — both heads' validation
+    accuracies must clear 0.9 (asserted in-script)."""
+    out = _run_example("multi_task.py", "--num-epochs", "8")
+    assert "parity accuracy" in out

@@ -141,3 +141,40 @@ def test_multi_slot_metric():
     names, values = m.get()
     assert names == ["head_0", "head_1"]
     assert values == [1.0, 0.0]
+
+
+def test_metric_shape_robustness():
+    """Every common metric must score IDENTICALLY across the shape
+    conventions modules actually emit: labels as (N,) or (N,1), class
+    preds as (N,) ids or (N,C) probabilities, regression preds as (N,)
+    or (N,1). The MSE 1-d-pred broadcast bug motivated pinning this
+    property for the whole family."""
+    labels = [1.0, 0.0, 1.0, 1.0]
+    probs = [[0.2, 0.8], [0.9, 0.1], [0.6, 0.4], [0.3, 0.7]]
+    ids = [1.0, 0.0, 0.0, 1.0]
+    reg_pred = [0.9, 0.1, 0.4, 0.6]
+
+    def score(metric_fn, lab, pred):
+        m = metric_fn()
+        m.update([_nd(lab)], [_nd(pred)])
+        return m.get()[1]
+
+    lab_shapes = [labels, [[v] for v in labels]]  # (N,) and (N,1)
+    for lab in lab_shapes:
+        # classification: (N,C) probs and (N,) hard ids must agree with
+        # their own kind across label shapes
+        assert abs(score(mx.metric.Accuracy, lab, probs)
+                   - score(mx.metric.Accuracy, labels, probs)) < 1e-9
+        assert abs(score(mx.metric.Accuracy, lab, ids)
+                   - score(mx.metric.Accuracy, labels, ids)) < 1e-9
+        assert abs(score(mx.metric.F1, lab, probs)
+                   - score(mx.metric.F1, labels, probs)) < 1e-9
+        assert abs(score(mx.metric.CrossEntropy, lab, probs)
+                   - score(mx.metric.CrossEntropy, labels, probs)) < 1e-9
+        # regression: (N,) and (N,1) predictions must agree
+        a = score(mx.metric.MSE, lab, reg_pred)
+        b = score(mx.metric.MSE, lab, [[v] for v in reg_pred])
+        assert abs(a - b) < 1e-9, (a, b)
+        c = score(mx.metric.MAE, lab, reg_pred)
+        d = score(mx.metric.MAE, lab, [[v] for v in reg_pred])
+        assert abs(c - d) < 1e-9, (c, d)

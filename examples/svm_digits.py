@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Linear/kernel-ish SVM head on digits (parity: reference
+example/svm_mnist — the SVMOutput loss head: multiclass hinge loss
+with margin, L2-style regularization baked into the op's gradient).
+
+Run:  python examples/svm_digits.py [--ctx cpu] [--use-linear]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from common import add_fit_args, get_context
+import mxnet_tpu as mx
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(p)
+    p.add_argument("--use-linear", action="store_true",
+                   help="L1 hinge (reference use_linear=1) instead of "
+                        "squared hinge")
+    p.set_defaults(num_epochs=12, batch_size=100, lr=0.1)
+    args = p.parse_args()
+    ctx = get_context(args)
+
+    from sklearn.datasets import load_digits
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    d = load_digits()
+    X = (d.images / 16.0).astype(np.float32).reshape(-1, 64)
+    y = d.target.astype(np.float32)
+    n = 1500
+    it = mx.io.NDArrayIter(X[:n], y[:n], batch_size=args.batch_size,
+                           shuffle=True, label_name="svm_label")
+    val = mx.io.NDArrayIter(X[n:], y[n:], batch_size=args.batch_size,
+                            label_name="svm_label")
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SVMOutput(net, mx.sym.Variable("svm_label"),
+                           margin=1.0, regularization_coefficient=1.0,
+                           use_linear=args.use_linear, name="svm")
+
+    mod = mx.mod.Module(net, context=ctx, label_names=["svm_label"])
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs)
+
+    val.reset()
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("svm accuracy: %.3f (%s hinge)"
+          % (acc, "L1" if args.use_linear else "squared"))
+    assert acc >= 0.9, acc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,3 +76,29 @@ def test_multi_task_both_heads_learn():
     accuracies must clear 0.9 (asserted in-script)."""
     out = _run_example("multi_task.py", "--num-epochs", "8")
     assert "parity accuracy" in out
+
+
+def test_svm_output_head_trains():
+    """examples/svm_digits.py (reference example/svm_mnist): the
+    SVMOutput hinge-loss head must train to >=0.9 (asserted in-script;
+    both squared and L1 hinge variants share the path)."""
+    out = _run_example("svm_digits.py")  # 12-epoch default: margin
+    assert "svm accuracy" in out
+
+
+def test_custom_numpy_op_trains():
+    """examples/numpy_ops.py (reference example/numpy-ops): a user
+    CustomOp (numpy softmax loss) in the training graph — forward AND
+    backward in host python — must reach >=0.9 (asserted in-script)."""
+    out = _run_example("numpy_ops.py")
+    assert "custom-numpy-softmax accuracy" in out
+
+
+def test_cnn_text_classification_learns_ngrams():
+    """examples/cnn_text_classification.py (reference
+    example/cnn_text_classification): multi-width conv branches over
+    embeddings must solve a bigram-order task bag-of-words cannot
+    (script asserts accuracy; 0.988 at 5 epochs)."""
+    out = _run_example("cnn_text_classification.py", "--num-epochs", "4",
+                       "--min-acc", "0.75", timeout=560)
+    assert "sentence accuracy" in out

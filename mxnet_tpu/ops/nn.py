@@ -894,6 +894,11 @@ def _batch_norm(attrs, ins, is_train):
         out = (data - mean.reshape(bshape)) * jax.lax.rsqrt(
             var.reshape(bshape) + eps
         ) * gamma.reshape(bshape) + beta.reshape(bshape)
+        # normalize in the STATS dtype (f32 moving stats) but return the
+        # input's dtype: a bf16 graph's inference BN must not upcast the
+        # activation stream — the next conv would see (f32, bf16) and
+        # type inference already promised it data.dtype
+        out = out.astype(data.dtype)
     else:
         out, mean, var = _bn_train_core(data, gamma, beta, eps)
         new_mean = momentum * moving_mean + (1.0 - momentum) * mean.astype(

@@ -106,3 +106,22 @@ def test_executor_path_uses_per_device_stats():
     # and it is NOT the global-batch answer: the paths genuinely differ
     global_expect = MOM * 1.0 + (1 - MOM) * X.var(axis=(0, 2, 3))
     assert np.all(global_expect > 10 * aux["bn_moving_var"])
+
+
+def test_bn_inference_preserves_reduced_precision_dtype():
+    """A bf16 graph's inference BN (f32 moving stats) must emit bf16,
+    not upcast the activation stream — the downstream conv was promised
+    data.dtype by type inference and crashes on (f32, bf16) otherwise.
+    Regression for the models/resnet dtype='bfloat16' score() path."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Cast(data, dtype="bfloat16")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), name="c2")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8))
+    exe.arg_dict["data"][:] = np.random.RandomState(0).rand(
+        2, 3, 8, 8).astype(np.float32)
+    out = exe.forward(is_train=False)[0]
+    assert str(out.asnumpy().dtype) == "bfloat16"

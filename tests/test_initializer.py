@@ -1,0 +1,109 @@
+"""Initializer semantics (parity: reference
+tests/python/unittest/test_init.py — default init, variable-attr
+overrides, aux init — plus the distribution/shape properties the
+reference took on faith)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _init_array(init, name, shape, seed=0):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    arr = mx.nd.zeros(shape)
+    init(mx.init.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_default_init_distributions():
+    u = _init_array(mx.init.Uniform(0.5), "fc_weight", (200, 100))
+    assert abs(u.mean()) < 0.02 and u.min() >= -0.5 and u.max() <= 0.5
+    n = _init_array(mx.init.Normal(2.0), "fc_weight", (200, 100))
+    assert abs(n.std() - 2.0) < 0.05
+    assert (_init_array(mx.init.Zero(), "w", (5, 5)) == 0).all()
+    assert (_init_array(mx.init.One(), "w", (5, 5)) == 1).all()
+    c = _init_array(mx.init.Constant(3.5), "w", (5, 5))
+    assert (c == 3.5).all()
+
+
+def test_name_based_rules():
+    """bias/gamma/beta/moving_* get their conventional values whatever
+    the weight initializer is (reference Initializer.__call__ routing)."""
+    init = mx.init.Uniform(1.0)
+    assert (_init_array(init, "fc_bias", (32,)) == 0).all()
+    assert (_init_array(init, "bn_gamma", (32,)) == 1).all()
+    assert (_init_array(init, "bn_beta", (32,)) == 0).all()
+    assert (_init_array(init, "bn_moving_mean", (32,)) == 0).all()
+    assert (_init_array(init, "bn_moving_var", (32,)) == 1).all()
+
+
+def test_xavier_scales_with_fan():
+    """Xavier magnitude follows sqrt(scale / fan): doubling fan_in
+    roughly shrinks std by sqrt(2)."""
+    a = _init_array(mx.init.Xavier(rnd_type="gaussian",
+                                   factor_type="in", magnitude=2),
+                    "fc_weight", (64, 100))
+    b = _init_array(mx.init.Xavier(rnd_type="gaussian",
+                                   factor_type="in", magnitude=2),
+                    "fc_weight", (64, 200))
+    ratio = a.std() / b.std()
+    assert abs(ratio - np.sqrt(2)) < 0.15, ratio
+
+
+def test_orthogonal_is_orthogonal():
+    w = _init_array(mx.init.Orthogonal(scale=1.0), "fc_weight", (32, 64))
+    wwt = w @ w.T
+    np.testing.assert_allclose(wwt, np.eye(32), atol=1e-4)
+
+
+def test_msra_prelu_variance():
+    """MSRAPrelu: std ~= sqrt(2/((1+a^2) fan)) for the 'in' factor."""
+    shape = (64, 400)
+    w = _init_array(mx.init.MSRAPrelu(factor_type="in", slope=0.0),
+                    "fc_weight", shape)
+    assert abs(w.std() - np.sqrt(2.0 / 400)) < 0.01
+
+
+def test_bilinear_upsampling_kernel():
+    """Bilinear fills a deconv kernel with the standard upsampling
+    weights (reference test for UpSampling init)."""
+    w = _init_array(mx.init.Bilinear(), "up_weight", (2, 1, 4, 4))
+    # 4x4 bilinear kernel for factor 2: rows [.25 .75 .75 .25] outer
+    expect = np.outer([0.25, 0.75, 0.75, 0.25],
+                      [0.25, 0.75, 0.75, 0.25])
+    np.testing.assert_allclose(w[0, 0], expect, atol=1e-6)
+    np.testing.assert_allclose(w[1, 0], expect, atol=1e-6)
+
+
+def test_load_and_mixed():
+    """Load serves saved params (with default fallback); Mixed routes
+    by name pattern (reference test_init.py variable/aux flows)."""
+    saved = {"fc_weight": mx.nd.array(np.full((4, 4), 7.0, np.float32))}
+    load = mx.init.Load(saved, default_init=mx.init.Zero())
+    assert (_init_array(load, "fc_weight", (4, 4)) == 7.0).all()
+    assert (_init_array(load, "other_weight", (2, 2)) == 0).all()
+
+    # NOTE: name routing still applies INSIDE each sub-initializer
+    # (reference semantics: Mixed([".*bias"], [One()]) still zeros a
+    # bias), so route on an unconventional suffix to see the pattern
+    # dispatch itself.
+    mixed = mx.init.Mixed([".*code", ".*"],
+                          [mx.init.One(), mx.init.Constant(2.0)])
+    assert (_init_array(mixed, "fc_code", (3,)) == 1).all()
+    assert (_init_array(mixed, "fc_weight", (3, 3)) == 2.0).all()
+
+
+def test_init_params_respects_variable_init_attr():
+    """A Variable's __init__ attribute overrides the module-level
+    initializer (reference test_init.py's variable init case)."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("myw", init=mx.init.One(), shape=(8, 8))
+    net = mx.sym.FullyConnected(data, weight=w, num_hidden=8,
+                                no_bias=True, name="fc")
+    net = mx.sym.LinearRegressionOutput(net, mx.sym.Variable("lab"))
+    mod = mx.mod.Module(net, label_names=["lab"])
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("lab", (2, 8))])
+    mod.init_params(mx.init.Zero())
+    args, _ = mod.get_params()
+    assert (args["myw"].asnumpy() == 1).all(), "variable init ignored"

@@ -63,11 +63,13 @@ def test_mlp_digits_reaches_97_percent():
     assert val_acc >= 0.95, val_acc
 
 
-def test_lenet_digits_converges():
-    """reference test_conv.py gate: a conv net (conv/pool/BN path) must
-    also cross the accuracy bar."""
+def _lenet(cast_dtype=None):
+    """The shared conv/pool/BN lenet topology for the train tier; with
+    cast_dtype the compute runs in that precision with an f32 loss head
+    (the recipe models/resnet.py dtype=... uses)."""
     data = mx.sym.Variable("data")
-    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+    net = mx.sym.Cast(data, dtype=cast_dtype) if cast_dtype else data
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16,
                              pad=(1, 1), name="conv1")
     net = mx.sym.BatchNorm(net, name="bn1")
     net = mx.sym.Activation(net, act_type="relu")
@@ -80,8 +82,28 @@ def test_lenet_digits_converges():
     net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
     net = mx.sym.Activation(net, act_type="relu")
     net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
-    net = mx.sym.SoftmaxOutput(net, name="softmax")
-    train_acc, val_acc = _fit_and_score(net, reshape=(1, 8, 8),
+    if cast_dtype:
+        net = mx.sym.Cast(net, dtype="float32")  # f32 loss head
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_lenet_digits_converges():
+    """reference test_conv.py gate: a conv net (conv/pool/BN path) must
+    also cross the accuracy bar."""
+    train_acc, val_acc = _fit_and_score(_lenet(), reshape=(1, 8, 8),
                                         num_epoch=20, lr=0.05)
     assert train_acc >= 0.99, train_acc
     assert val_acc >= 0.95, val_acc
+
+
+def test_lenet_digits_converges_bfloat16():
+    """Reduced-precision train tier (reference
+    tests/python/train/test_dtype.py — fp16 CIFAR training): the SAME
+    lenet topology (incl. BatchNorm) with bfloat16 compute and an f32
+    loss head must converge; bars sit one point under the f32 gate to
+    absorb reduced-precision noise."""
+    train_acc, val_acc = _fit_and_score(_lenet("bfloat16"),
+                                        reshape=(1, 8, 8),
+                                        num_epoch=20, lr=0.05)
+    assert train_acc >= 0.98, train_acc
+    assert val_acc >= 0.94, val_acc

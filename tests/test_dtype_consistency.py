@@ -1,0 +1,59 @@
+"""Runtime dtype must match declared (inferred) dtype for every layer
+op in a reduced-precision graph — in BOTH train and inference modes.
+
+Motivated by the BatchNorm inference bug (f32 moving stats upcast the
+bf16 activation stream; the next conv crashed on mixed dtypes): type
+inference promises downstream ops data.dtype, so any op that silently
+promotes breaks the chain. This sweep binds each layer under a
+bfloat16 cast and checks the output dtype both ways.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+LAYERS = [
+    ("conv", lambda x: mx.sym.Convolution(
+        x, kernel=(3, 3), num_filter=4, pad=(1, 1), name="op")),
+    ("deconv", lambda x: mx.sym.Deconvolution(
+        x, kernel=(2, 2), stride=(2, 2), num_filter=4, name="op")),
+    ("pool_max", lambda x: mx.sym.Pooling(
+        x, kernel=(2, 2), stride=(2, 2), pool_type="max")),
+    ("pool_avg", lambda x: mx.sym.Pooling(
+        x, kernel=(2, 2), stride=(2, 2), pool_type="avg")),
+    ("bn", lambda x: mx.sym.BatchNorm(x, name="op")),
+    ("lrn", lambda x: mx.sym.LRN(x, nsize=3)),
+    ("act", lambda x: mx.sym.Activation(x, act_type="relu")),
+    ("leaky", lambda x: mx.sym.LeakyReLU(x, act_type="leaky")),
+    ("dropout", lambda x: mx.sym.Dropout(x, p=0.3)),
+    ("fc", lambda x: mx.sym.FullyConnected(
+        mx.sym.Flatten(x), num_hidden=6, name="op")),
+    ("concat_self", lambda x: mx.sym.Concat(x, x)),
+    ("elemwise", lambda x: x + x * 0.5),
+    ("softmax_act", lambda x: mx.sym.SoftmaxActivation(
+        mx.sym.Flatten(x))),
+]
+
+
+@pytest.mark.parametrize("name,layer", LAYERS, ids=[n for n, _ in LAYERS])
+@pytest.mark.parametrize("is_train", [True, False],
+                         ids=["train", "infer"])
+def test_layer_preserves_bf16(name, layer, is_train):
+    data = mx.sym.Variable("data")
+    net = layer(mx.sym.Cast(data, dtype="bfloat16"))
+    declared = net.infer_type(data="float32")[1][0]
+    assert np.dtype(declared).name == "bfloat16", (
+        "%s DECLARES %s for a bf16 input" % (name, declared))
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8),
+                          grad_req="null")
+    rng = np.random.RandomState(0)
+    for k, a in exe.arg_dict.items():
+        if k != "data":
+            a[:] = (rng.rand(*a.shape).astype(np.float32) - 0.5)
+    exe.arg_dict["data"][:] = rng.rand(2, 3, 8, 8).astype(np.float32)
+    out = exe.forward(is_train=is_train)[0]
+    got = out.asnumpy().dtype
+    assert got.name == "bfloat16", (
+        "%s emits %s at runtime for a bf16 input (%s mode) — type "
+        "inference promised bfloat16 downstream"
+        % (name, got, "train" if is_train else "infer"))

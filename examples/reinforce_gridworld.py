@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""REINFORCE policy gradient on a self-contained gridworld (parity:
+reference example/reinforcement-learning — policy-gradient training
+through the symbolic API; theirs wraps gym/ALE, ours ships its own
+5x5 gridworld so it runs anywhere).
+
+The policy net trains through `MakeLoss(-log pi(a|s) * advantage)`
+(pick + log_softmax + BlockGrad'd advantages) — the canonical
+score-function estimator as a Symbol graph. Gate: mean episode return
+improves by >=0.5 over the random-policy baseline (observed
+-0.41 -> ~0.86, near-optimal for the step costs).
+
+Run:  python examples/reinforce_gridworld.py [--ctx cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from common import add_fit_args, get_context
+import mxnet_tpu as mx
+
+GRID = 5
+N_STATES = GRID * GRID
+N_ACT = 4  # up/down/left/right
+GOAL = (GRID - 1, GRID - 1)
+HORIZON = 12
+STEP_R = -0.05
+GOAL_R = 1.0
+
+
+def step(state, act):
+    r, c = divmod(state, GRID)
+    dr, dc = [(-1, 0), (1, 0), (0, -1), (0, 1)][act]
+    r2, c2 = min(max(r + dr, 0), GRID - 1), min(max(c + dc, 0), GRID - 1)
+    s2 = r2 * GRID + c2
+    done = (r2, c2) == GOAL
+    return s2, (GOAL_R if done else STEP_R), done
+
+
+def rollout(probs_fn, rng, n_episodes):
+    """Sample episodes with the current policy; returns flat
+    (states, actions, returns) and the mean episode return."""
+    S, A, R = [], [], []
+    ep_returns = []
+    for _ in range(n_episodes):
+        s = rng.randint(0, N_STATES - 1)
+        traj, rewards = [], []
+        for _t in range(HORIZON):
+            p = probs_fn(s)
+            a = rng.choice(N_ACT, p=p)
+            s2, r, done = step(s, a)
+            traj.append((s, a))
+            rewards.append(r)
+            s = s2
+            if done:
+                break
+        ret = 0.0
+        returns = []
+        for r in reversed(rewards):
+            ret = r + 0.98 * ret
+            returns.append(ret)
+        returns.reverse()
+        for (st, ac), g in zip(traj, returns):
+            S.append(st)
+            A.append(ac)
+            R.append(g)
+        ep_returns.append(sum(rewards))
+    return (np.asarray(S, np.float32), np.asarray(A, np.float32),
+            np.asarray(R, np.float32), float(np.mean(ep_returns)))
+
+
+def build_policy():
+    state = mx.sym.Variable("state")
+    act = mx.sym.Variable("action")
+    adv = mx.sym.Variable("advantage")
+    onehot = mx.sym.one_hot(state, depth=N_STATES)
+    h = mx.sym.FullyConnected(onehot, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    logits = mx.sym.FullyConnected(h, num_hidden=N_ACT, name="fc2")
+    logp = mx.sym.log_softmax(logits, axis=-1)
+    chosen = mx.sym.pick(logp, act, axis=-1)
+    loss = mx.sym.MakeLoss(
+        -chosen * mx.sym.BlockGrad(adv), name="pg_loss")
+    probs = mx.sym.BlockGrad(mx.sym.softmax(logits, axis=-1),
+                             name="probs")
+    return mx.sym.Group([loss, probs])
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(p)
+    p.add_argument("--iters", type=int, default=60)
+    p.add_argument("--episodes", type=int, default=64)
+    p.set_defaults(lr=0.05)
+    args = p.parse_args()
+    ctx = get_context(args)
+    one_ctx = ctx[0] if isinstance(ctx, list) else ctx
+
+    rng = np.random.RandomState(0)
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    sym = build_policy()
+    # bind once at the max flat-batch size; pad shorter batches
+    max_n = args.episodes * HORIZON
+    exe = sym.simple_bind(ctx=one_ctx, state=(max_n,), action=(max_n,),
+                          advantage=(max_n,), grad_req="write")
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("state", "action", "advantage"):
+            init(mx.init.InitDesc(name), arr)
+    # rescale happens per update with the REAL step count n (padding
+    # contributes zero gradient but must not dilute the mean)
+    opt = mx.optimizer.create("adam", learning_rate=args.lr,
+                              rescale_grad=1.0)
+    updater = mx.optimizer.get_updater(opt)
+    params = [n for n in exe.arg_dict
+              if n not in ("state", "action", "advantage")]
+
+    # evaluate the whole policy table once per iteration (one forward
+    # serves every state lookup of the rollout batch)
+    def policy_table():
+        states = np.arange(N_STATES, dtype=np.float32)
+        exe.arg_dict["state"][:] = np.resize(states, max_n)
+        out = exe.forward(is_train=False)[1].asnumpy()[:N_STATES]
+        return out / out.sum(axis=1, keepdims=True)
+
+    base_return = None
+    for it in range(args.iters):
+        table = policy_table()
+        S, A, R, mean_ret = rollout(lambda s: table[s], rng,
+                                    args.episodes)
+        if base_return is None:
+            base_return = mean_ret  # near-random policy baseline
+        adv = (R - R.mean()) / len(S)  # per-sample mean over REAL steps
+        n = len(S)
+        pad = max_n - n
+        exe.arg_dict["state"][:] = np.pad(S, (0, pad))
+        exe.arg_dict["action"][:] = np.pad(A, (0, pad))
+        exe.arg_dict["advantage"][:] = np.pad(adv, (0, pad))
+        exe.forward(is_train=True)
+        exe.backward()
+        for i, name in enumerate(params):
+            updater(i, exe.grad_dict[name], exe.arg_dict[name])
+        if (it + 1) % 15 == 0:
+            print("iter %3d mean return %.3f" % (it + 1, mean_ret))
+    final = mean_ret
+    print("random-policy return %.3f -> trained %.3f"
+          % (base_return, final))
+    assert final > base_return + 0.5, (base_return, final)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Stochastic-depth residual training (parity: reference
+example/stochastic-depth — residual branches are randomly dropped
+during training and survival-probability-scaled at inference,
+regularizing very deep nets).
+
+Each residual block computes  x + gate * branch(x)  where gate is a
+per-batch Bernoulli(p_survive) draw from `mx.sym.uniform` at train
+time and the constant p_survive at test time — the symbolic-RNG
+pattern (the same uniform op Dropout uses). Gates are ZEROED, not
+compute-skipped (XLA traces a static graph; the regularization effect
+is identical). Gate: the expectation-scaled deterministic net scores
+>=0.85 on held-out digits from the stochastically-trained weights.
+
+Run:  python examples/stochastic_depth.py [--ctx cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from common import add_fit_args, get_context
+import mxnet_tpu as mx
+
+
+def res_block(x, nf, name, p_survive, stochastic):
+    branch = mx.sym.Convolution(x, kernel=(3, 3), num_filter=nf,
+                                pad=(1, 1), name=name + "_c1")
+    branch = mx.sym.BatchNorm(branch, name=name + "_bn")
+    branch = mx.sym.Activation(branch, act_type="relu")
+    branch = mx.sym.Convolution(branch, kernel=(3, 3), num_filter=nf,
+                                pad=(1, 1), name=name + "_c2")
+    if stochastic:
+        # one Bernoulli(p_survive) gate per batch: keep the branch with
+        # prob p, else the block is an identity this step
+        u = mx.sym.uniform(low=0.0, high=1.0, shape=(1,))
+        gate = mx.sym._lesser_scalar(u, scalar=p_survive)
+        branch = mx.sym.broadcast_mul(branch, gate)
+    else:
+        branch = branch * p_survive  # inference-style expectation scale
+    return x + branch
+
+
+def build(depth, p_survive, stochastic):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                             pad=(1, 1), name="stem")
+    net = mx.sym.Activation(net, act_type="relu")
+    for i in range(depth):
+        net = res_block(net, 16, "blk%d" % i, p_survive, stochastic)
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                name="cls")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(p)
+    p.add_argument("--depth", type=int, default=3)
+    p.add_argument("--p-survive", type=float, default=0.75)
+    p.add_argument("--min-acc", type=float, default=0.85)
+    p.set_defaults(num_epochs=22, batch_size=100, lr=0.1)
+    args = p.parse_args()
+    ctx = get_context(args)
+
+    from sklearn.datasets import load_digits
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    d = load_digits()
+    X = (d.images / 16.0).astype(np.float32).reshape(-1, 1, 8, 8)
+    y = d.target.astype(np.float32)
+    n = 1500
+    it = mx.io.NDArrayIter(X[:n], y[:n], batch_size=args.batch_size,
+                           shuffle=True)
+    val = mx.io.NDArrayIter(X[n:], y[n:], batch_size=args.batch_size)
+
+    # train WITH stochastic depth...
+    mod = mx.mod.Module(build(args.depth, args.p_survive, True),
+                        context=ctx)
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            num_epoch=args.num_epochs)
+    args_p, aux_p = mod.get_params()
+
+    # ...score with the deterministic expectation-scaled net
+    infer = mx.mod.Module(build(args.depth, args.p_survive, False),
+                          context=ctx)
+    infer.bind(data_shapes=val.provide_data,
+               label_shapes=val.provide_label, for_training=False)
+    infer.set_params(args_p, aux_p)
+    val.reset()
+    acc = dict(infer.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("stochastic-depth val accuracy (expectation-scaled): %.3f"
+          % acc)
+    assert acc >= args.min_acc, acc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,3 +131,14 @@ def test_reinforce_gridworld_improves():
     0.86)."""
     out = _run_example("reinforce_gridworld.py", "--iters", "35")
     assert "-> trained" in out
+
+
+def test_stochastic_depth_trains_and_rescales():
+    """examples/stochastic_depth.py (reference example/stochastic-depth):
+    Bernoulli-gated residual branches (symbolic mx.sym.uniform) at train
+    time, expectation-scaled at inference — the rescaled deterministic
+    net must score >= the enforced --min-acc 0.8 from stochastically-
+    trained weights (observed ~0.91 at the 22-epoch default)."""
+    out = _run_example("stochastic_depth.py", "--min-acc", "0.8",
+                       timeout=560)  # 22-epoch default, observed ~0.91
+    assert "expectation-scaled" in out

@@ -142,3 +142,14 @@ def test_stochastic_depth_trains_and_rescales():
     out = _run_example("stochastic_depth.py", "--min-acc", "0.8",
                        timeout=560)  # 22-epoch default, observed ~0.91
     assert "expectation-scaled" in out
+
+
+def test_dec_clustering_pipeline():
+    """examples/dec_clustering.py (reference example/dec): AE pretrain
+    -> k-means init -> KL(P||Q) joint refinement with trainable
+    centers; clustering accuracy (Hungarian map) must stay within
+    tolerance of the k-means init and above 0.6 (asserted in-script)."""
+    out = _run_example("dec_clustering.py", "--num-epochs", "15",
+                       "--refine-rounds", "3", "--lr", "0.001",
+                       timeout=560)
+    assert "DEC refined acc" in out

@@ -27,6 +27,23 @@ import traceback
 from collections import deque
 
 from .base import MXNetError, get_env
+from . import telemetry as _tm
+
+# module-level handles: .inc()/.set()/.observe() are guarded no-ops
+# while telemetry is disabled, so the hot path pays one flag check
+_M_OPS_PUSHED = _tm.counter(
+    "engine.ops_pushed", "ops pushed to the host dependency engine")
+_M_OPS_EXECUTED = _tm.counter(
+    "engine.ops_executed", "ops executed by engine workers")
+_M_OP_ERRORS = _tm.counter(
+    "engine.op_errors", "async ops that raised (surfaced via raise_pending)")
+_M_WORKER_WAIT = _tm.counter(
+    "engine.worker_wait_seconds",
+    "cumulative time workers spent waiting for runnable ops")
+_G_QUEUE_DEPTH = _tm.gauge(
+    "engine.queue_depth", "ready-queue depth at last dispatch/pop")
+_H_OP_SECONDS = _tm.histogram(
+    "engine.op_seconds", "execution time of engine-scheduled ops")
 
 
 class Var:
@@ -113,6 +130,7 @@ class ThreadedEngine:
         const_vars = list(const_vars)
         mutable_vars = list(mutable_vars)
         self._check_duplicate(const_vars, mutable_vars)
+        _M_OPS_PUSHED.inc()
         opr = _OprBlock(fn, const_vars, mutable_vars, priority, name)
         with self._lock:
             self._inflight += 1
@@ -161,14 +179,21 @@ class ThreadedEngine:
         with self._ready_cv:
             heapq.heappush(self._ready, (-opr.priority, self._seq, opr))
             self._seq += 1
+            if _tm.enabled():
+                _G_QUEUE_DEPTH.set(len(self._ready))
             self._ready_cv.notify()
 
     def _worker(self):
         while True:
             with self._ready_cv:
-                while not self._ready:
-                    self._ready_cv.wait()
+                if not self._ready:
+                    t0 = time.monotonic()
+                    while not self._ready:
+                        self._ready_cv.wait()
+                    _M_WORKER_WAIT.inc(time.monotonic() - t0)
                 _, _, opr = heapq.heappop(self._ready)
+                if _tm.enabled():
+                    _G_QUEUE_DEPTH.set(len(self._ready))
             self._execute(opr)
 
     def _execute(self, opr):
@@ -180,8 +205,12 @@ class ThreadedEngine:
             # eventually deadlocks every dependent op); record for
             # raise_pending() and keep going.
             self._errors.append(e)
+            _M_OP_ERRORS.inc()
             traceback.print_exc(file=sys.stderr)
         finally:
+            _M_OPS_EXECUTED.inc()
+            if _tm.enabled():
+                _H_OP_SECONDS.observe(time.monotonic() - t0)
             trace = self._trace
             if trace is not None:
                 trace.append({
@@ -272,7 +301,9 @@ class NaiveEngine:
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
              name=None):
+        _M_OPS_PUSHED.inc()
         fn()
+        _M_OPS_EXECUTED.inc()
 
     def raise_pending(self):
         pass

@@ -23,6 +23,7 @@ from __future__ import annotations
 import functools
 import itertools
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -30,12 +31,54 @@ import numpy as np
 
 from . import ndarray as nd
 from . import random as _random
+from . import telemetry as _tm
 from .base import MXNetError, np_dtype
 from .context import Context
 from .ndarray import NDArray
 from .symbol import Symbol, _topo_order
 
 __all__ = ["Executor"]
+
+_M_COMPILE_COUNT = _tm.counter(
+    "executor.jit_compile_count", "XLA trace+compile events, by segment key")
+_M_COMPILE_SECONDS = _tm.counter(
+    "executor.jit_compile_seconds",
+    "wall seconds spent in first-call trace+compile, by segment key")
+_M_CACHE_HITS = _tm.counter(
+    "executor.fn_cache_hits", "compiled-callable cache hits, by segment key")
+_M_CACHE_MISSES = _tm.counter(
+    "executor.fn_cache_misses",
+    "compiled-callable cache misses (compiles), by segment key")
+_H_STEP_SECONDS = _tm.histogram(
+    "executor.step_seconds", "executor forward / fused fwd+bwd dispatch time")
+
+
+def _instrument_jit(fn, key):
+    """Wrap a jitted callable with compile/cache accounting: the first
+    call is where jax traces + XLA compiles (recorded as a cache miss
+    plus compile count/seconds under ``segment=key``); every later call
+    counts as a cache hit. Zero-overhead passthrough while telemetry is
+    disabled."""
+    state = {"compiled": False}
+
+    def wrapper(*args, **kwargs):
+        if not _tm.enabled():
+            state["compiled"] = True
+            return fn(*args, **kwargs)
+        if state["compiled"]:
+            _M_CACHE_HITS.inc(segment=key)
+            return fn(*args, **kwargs)
+        state["compiled"] = True
+        _M_CACHE_MISSES.inc(segment=key)
+        with _tm.span("jit_compile", segment=key):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+        _M_COMPILE_COUNT.inc(segment=key)
+        _M_COMPILE_SECONDS.inc(dt, segment=key)
+        return out
+
+    return wrapper
 
 
 def _as_jax(x):
@@ -311,7 +354,10 @@ class _PlacedProgram:
     def _seg_fn(self, si, is_train):
         key = ("fwd", si, is_train)
         if key not in self._fn_cache:
+            _M_CACHE_MISSES.inc(segment="seg%d_fwd" % si)
             self._fn_cache[key] = jax.jit(self._seg_run(si, is_train))
+        else:
+            _M_CACHE_HITS.inc(segment="seg%d_fwd" % si)
         return self._fn_cache[key]
 
     def _seg_bwd_fn(self, si):
@@ -320,6 +366,7 @@ class _PlacedProgram:
         inputs that can reach a gradient variable."""
         key = ("bwd", si)
         if key not in self._fn_cache:
+            _M_CACHE_MISSES.inc(segment="seg%d_bwd" % si)
             needs, _, _ = self._seg_io[si]
             diff_idx = tuple(
                 i for i, (nid, _o) in enumerate(needs)
@@ -344,6 +391,8 @@ class _PlacedProgram:
                 return cts_in
 
             self._fn_cache[key] = (jax.jit(bwd), diff_idx)
+        else:
+            _M_CACHE_HITS.inc(segment="seg%d_bwd" % si)
         return self._fn_cache[key]
 
     @staticmethod
@@ -477,11 +526,13 @@ class Executor:
         )
         self._placed = self._build_placed()
         if self._placed is not None:
-            self._fwd_jit = self._make_fwd_placed()
-            self._fwdbwd_jit = self._make_fwdbwd_placed()
+            self._fwd_jit = _instrument_jit(
+                self._make_fwd_placed(), "fwd_placed")
+            self._fwdbwd_jit = _instrument_jit(
+                self._make_fwdbwd_placed(), "fwdbwd_placed")
         else:
-            self._fwd_jit = self._make_fwd()
-            self._fwdbwd_jit = self._make_fwdbwd()
+            self._fwd_jit = _instrument_jit(self._make_fwd(), "fwd")
+            self._fwdbwd_jit = _instrument_jit(self._make_fwdbwd(), "fwdbwd")
         self._pending_train_step = False
 
     def _build_placed(self):
@@ -672,7 +723,11 @@ class Executor:
             # single fused fwd+bwd launch.
             return _LazyOutputs(self)
         self._pending_train_step = False
-        outs, new_aux = self._fwd_jit(arg_vals, aux_vals, rng, bool(is_train))
+        with _tm.span("executor.forward", train=bool(is_train)):
+            t0 = time.perf_counter()
+            outs, new_aux = self._fwd_jit(
+                arg_vals, aux_vals, rng, bool(is_train))
+            _H_STEP_SECONDS.observe(time.perf_counter() - t0, phase="fwd")
         self._set_outputs(outs)
         if is_train:
             for a, v in zip(self.aux_arrays, new_aux):
@@ -721,7 +776,11 @@ class Executor:
             arg_vals = tuple(a._data for a in self.arg_arrays)
             aux_vals = tuple(a._data for a in self.aux_arrays)
             rng = _random.next_key() if self._needs_rng else None
-        outs, new_aux, grads = self._fwdbwd_jit(arg_vals, aux_vals, rng, out_grads)
+        with _tm.span("executor.fwdbwd"):
+            t0 = time.perf_counter()
+            outs, new_aux, grads = self._fwdbwd_jit(
+                arg_vals, aux_vals, rng, out_grads)
+            _H_STEP_SECONDS.observe(time.perf_counter() - t0, phase="fwdbwd")
         self._pending_train_step = False
         self._set_outputs(outs)
         for a, v in zip(self.aux_arrays, new_aux):

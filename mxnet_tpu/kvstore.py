@@ -42,12 +42,33 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
+
+import numpy as _np
 
 from . import engine as _engine
 from . import ndarray as nd
 from . import optimizer as opt
+from . import telemetry as _tm
 from .base import MXNetError
 from .ndarray import NDArray
+
+_M_PUSH_BYTES = _tm.counter(
+    "kvstore.push_bytes", "Bytes pushed into the kvstore")
+_M_PULL_BYTES = _tm.counter(
+    "kvstore.pull_bytes", "Bytes pulled out of the kvstore")
+_H_PUSH_SECONDS = _tm.histogram(
+    "kvstore.push_seconds", "Latency of the engine-side push body "
+    "(reduce + updater), per key")
+_H_PULL_SECONDS = _tm.histogram(
+    "kvstore.pull_seconds", "Latency of the engine-side pull body, per key")
+_H_ALLREDUCE_SECONDS = _tm.histogram(
+    "kvstore.allreduce_seconds", "Cross-process allreduce+update stage "
+    "latency (dist stores)")
+
+
+def _nbytes(vals):
+    return sum(int(v.size) * _np.dtype(v.dtype).itemsize for v in vals)
 
 
 def _ctype_key_value(keys, vals):
@@ -161,6 +182,8 @@ class KVStore(object):
             # the body is immune to the trainer overwriting the grad
             # NDArrays (next backward) before the op runs.
             snap = [NDArray(v._data) for v in vals]
+            if _tm.enabled():
+                _M_PUSH_BYTES.inc(_nbytes(snap))
 
             def _apply(merged, k, upd_key):
                 with self._update_lock:
@@ -171,7 +194,9 @@ class KVStore(object):
 
             if not self._is_dist:
                 def _do_push(snap=snap, k=k, upd_key=upd_key):
+                    t0 = time.perf_counter()
                     _apply(self._reduce(snap), k, upd_key)
+                    _H_PUSH_SECONDS.observe(time.perf_counter() - t0)
 
                 self._comm.push(_do_push, mutable_vars=[self._key_var(k)],
                                 priority=priority, name="push:%s" % k)
@@ -192,7 +217,9 @@ class KVStore(object):
 
             def _local_reduce(snap=snap, box=box):
                 try:
+                    t0 = time.perf_counter()
                     merged = self._reduce(snap)
+                    _H_PUSH_SECONDS.observe(time.perf_counter() - t0)
                     box["host"] = merged.asnumpy()
                     box["ctx"] = merged.context
                     box["dtype"] = merged.dtype
@@ -210,15 +237,15 @@ class KVStore(object):
                 from .parallel import mesh as _mesh
 
                 if "error" in box:
-                    import numpy as _np
-
                     _mesh.allreduce_sum(
                         _np.zeros(snap0.shape, dtype=snap0.dtype))
                     return  # error already recorded by stage 1
+                t0 = time.perf_counter()
                 merged = nd.array(
                     _mesh.allreduce_sum(box.pop("host")),
                     ctx=box.pop("ctx"), dtype=box.pop("dtype"))
                 _apply(merged, k, upd_key)
+                _H_ALLREDUCE_SECONDS.observe(time.perf_counter() - t0)
 
             if self._dist_chain is None:
                 self._dist_chain = self._comm.new_variable()
@@ -242,10 +269,13 @@ class KVStore(object):
         for k, outs in _ctype_key_value(key, out):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
+            if _tm.enabled():
+                _M_PULL_BYTES.inc(_nbytes(outs))
 
             def _do_pull(k=k, outs=outs):
                 import jax
 
+                t0 = time.perf_counter()
                 stored = self._store[k]
                 for o in outs:
                     # direct _data write, NOT copyto: copyto drains the
@@ -253,6 +283,7 @@ class KVStore(object):
                     # calling it here would self-deadlock
                     o._data = jax.device_put(stored._data,
                                              o._data.device)
+                _H_PULL_SECONDS.observe(time.perf_counter() - t0)
 
             out_vars = []
             seen = set()

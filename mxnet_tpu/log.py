@@ -40,8 +40,12 @@ class _Formatter(logging.Formatter):
                 % (self._color(record.levelno), label)
         else:
             head = "%s%%(asctime)s %%(process)d %%(pathname)s:%%(lineno)d]" % label
-        self._style._fmt = head + " %(message)s"
-        return super().format(record)
+        # build a per-call formatter instead of mutating the SHARED
+        # self._style._fmt: two handlers (or two threads) formatting
+        # records of different levels concurrently would race on the
+        # instance and emit each other's level tag/color
+        return logging.Formatter(
+            head + " %(message)s", datefmt=self.datefmt).format(record)
 
 
 def getLogger(name=None, filename=None, filemode=None, level=WARNING):

@@ -20,6 +20,7 @@ from . import metric as metric_mod
 from . import ndarray as nd
 from . import optimizer as opt
 from . import symbol as sym
+from . import telemetry as _tm
 from .base import MXNetError
 from .context import Context, cpu, current_context
 from .kvstore import KVStore
@@ -67,28 +68,30 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
     """Push grads, pull weights — the server-side-optimizer path
     (parity model.py:88-97)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+    with _tm.span("model.update_params", path="kvstore"):
+        for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+            arg_list, grad_list = pair
+            if grad_list[0] is None:
+                continue
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, arg_list, priority=-index)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
     """Local-updater path (parity model.py:99-117): reduce via kvstore if
     present, then per-device update with faked unique indices."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updater(index * num_device + k, g, w)
+    with _tm.span("model.update_params", path="local"):
+        for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+            arg_list, grad_list = pair
+            if grad_list[0] is None:
+                continue
+            if kvstore:
+                kvstore.push(index, grad_list, priority=-index)
+                kvstore.pull(index, grad_list, priority=-index)
+            for k, p in enumerate(zip(arg_list, grad_list)):
+                w, g = p
+                updater(index * num_device + k, g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

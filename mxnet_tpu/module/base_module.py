@@ -15,9 +15,16 @@ import time
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import telemetry as _tm
 from ..initializer import Uniform
 from ..model import BatchEndParam
 from ..io import DataDesc  # noqa: F401  (re-exported for subclasses)
+
+_H_STEP_SECONDS = _tm.histogram(
+    "fit.step_seconds", "Wall time of one fit-loop optimizer step "
+    "(forward_backward + update), labelled by epoch")
+_H_EPOCH_SECONDS = _tm.histogram(
+    "fit.epoch_seconds", "Wall time of one training epoch")
 
 
 def _as_list(obj):
@@ -190,7 +197,17 @@ class BaseModule(object):
                                 monitor=monitor)
 
                 if len(pending) == fit_k:
-                    steps = self.update_multi([b for _, b in pending])
+                    with _tm.span("fit.step_group", epoch=epoch,
+                                  k=len(pending)):
+                        t0 = time.perf_counter()
+                        steps = self.update_multi([b for _, b in pending])
+                        dt = time.perf_counter() - t0
+                    if _tm.enabled():
+                        # amortized per-step cost so the histogram stays
+                        # comparable with the single-step path
+                        per = dt / len(pending)
+                        for _ in pending:
+                            _H_STEP_SECONDS.observe(per, epoch=str(epoch))
                     for (nbatch, db), outs in zip(pending, steps):
                         self._install_step_outputs(outs)
                         self.update_metric(eval_metric, db.label)
@@ -200,8 +217,13 @@ class BaseModule(object):
                     # partial trailing group: single-step path (already
                     # compiled; a one-off K'-step compile isn't worth it)
                     for nbatch, db in pending:
-                        self.forward_backward(db)
-                        self.update()
+                        with _tm.span("fit.step", epoch=epoch,
+                                      nbatch=nbatch):
+                            t0 = time.perf_counter()
+                            self.forward_backward(db)
+                            self.update()
+                            _H_STEP_SECONDS.observe(
+                                time.perf_counter() - t0, epoch=str(epoch))
                         self.update_metric(eval_metric, db.label)
                         _fire(batch_end_callback, epoch, nbatch,
                               eval_metric, _cb_locals(nbatch, db))
@@ -228,8 +250,14 @@ class BaseModule(object):
                     continue
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                with _tm.span("fit.step", epoch=epoch, nbatch=nbatch):
+                    t0 = time.perf_counter()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    _H_STEP_SECONDS.observe(
+                        time.perf_counter() - t0, epoch=str(epoch))
+                if _tm.enabled():
+                    _tm.sample_device_memory()
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -243,6 +271,9 @@ class BaseModule(object):
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f",
                              epoch, time.time() - tic)
+            if _tm.enabled():
+                _H_EPOCH_SECONDS.observe(time.time() - tic)
+                _tm.flush()  # metrics snapshot per epoch (JSONL + prom)
 
             # sync params (and multi-device aux) back to the host copies
             arg_now, aux_now = self.get_params()

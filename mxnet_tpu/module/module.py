@@ -14,6 +14,7 @@ import numpy as np
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
+from .. import telemetry as _tm
 from ..base import MXNetError
 from ..initializer import Uniform, InitDesc
 from ..model import (
@@ -585,10 +586,11 @@ class Module(BaseModule):
                 self._fused_params = owner._fused_params
                 self._fused_aux = owner._fused_aux
                 self._fused_opt = owner._fused_opt
-            p, a, s, outs = self._fused_trainer(
-                owner._fused_params, owner._fused_aux, owner._fused_opt,
-                batch, lr=lr, t=owner._fused_t,
-            )
+            with _tm.span("module.update", path="fused"):
+                p, a, s, outs = self._fused_trainer(
+                    owner._fused_params, owner._fused_aux, owner._fused_opt,
+                    batch, lr=lr, t=owner._fused_t,
+                )
             owner._fused_params, owner._fused_aux, owner._fused_opt = p, a, s
             # raw jax.Arrays; _local_rows conversion (a host transfer in
             # multi-process runs) happens lazily on first read so loops
@@ -600,16 +602,19 @@ class Module(BaseModule):
             self._fused_exec_stale = True
             return
         if self._update_on_kvstore:
-            _update_params_on_kvstore(
-                self._exec_group.param_arrays, self._exec_group.grad_arrays,
-                self._kvstore
-            )
+            with _tm.span("module.update", path="kvstore"):
+                _update_params_on_kvstore(
+                    self._exec_group.param_arrays,
+                    self._exec_group.grad_arrays, self._kvstore
+                )
         else:
-            _update_params(
-                self._exec_group.param_arrays, self._exec_group.grad_arrays,
-                updater=self._updater, num_device=len(self._context),
-                kvstore=self._kvstore
-            )
+            with _tm.span("module.update", path="local"):
+                _update_params(
+                    self._exec_group.param_arrays,
+                    self._exec_group.grad_arrays,
+                    updater=self._updater, num_device=len(self._context),
+                    kvstore=self._kvstore
+                )
 
     def update_multi(self, data_batches):
         """Run len(data_batches) fused training steps in ONE XLA dispatch
@@ -730,19 +735,19 @@ class Module(BaseModule):
 
     def _sync_params_from_devices(self):
         """Parity module.py:666."""
-        if self._fused_trainer is not None:
-
-            owner = self._fused_owner
-            for name, arr in owner._fused_params.items():
-                if name in self._arg_params:
-                    self._arg_params[name][:] = np.asarray(arr)
-            for name, arr in owner._fused_aux.items():
-                if name in self._aux_params:
-                    self._aux_params[name][:] = np.asarray(arr)
+        with _tm.span("module.sync_params"):
+            if self._fused_trainer is not None:
+                owner = self._fused_owner
+                for name, arr in owner._fused_params.items():
+                    if name in self._arg_params:
+                        self._arg_params[name][:] = np.asarray(arr)
+                for name, arr in owner._fused_aux.items():
+                    if name in self._aux_params:
+                        self._aux_params[name][:] = np.asarray(arr)
+                self._params_dirty = False
+                return
+            self._exec_group.get_params(self._arg_params, self._aux_params)
             self._params_dirty = False
-            return
-        self._exec_group.get_params(self._arg_params, self._aux_params)
-        self._params_dirty = False
 
     def save_optimizer_states(self, fname):
         """Parity module.py:674."""

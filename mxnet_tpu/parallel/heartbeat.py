@@ -30,6 +30,35 @@ import os
 import threading
 import time
 
+try:
+    from .. import telemetry as _tm
+except ImportError:
+    # Loaded standalone by file path (tools/watchdog.py helpers and the
+    # failure-recovery tests do this so liveness needs zero heavy
+    # imports); record nothing in that mode.
+    class _NoopMetric:
+        def set(self, *args, **kwargs):
+            pass
+
+    class _NoopTelemetry:
+        @staticmethod
+        def enabled():
+            return False
+
+        @staticmethod
+        def gauge(*args, **kwargs):
+            return _NoopMetric()
+
+    _tm = _NoopTelemetry()
+
+_G_HB_AGE = _tm.gauge(
+    "heartbeat.age_seconds",
+    "Per-rank liveness-beat age at the last dead_nodes() poll "
+    "(inf = never beat)")
+_G_PROG_AGE = _tm.gauge(
+    "heartbeat.progress_age_seconds",
+    "Per-rank progress-mark age at the last stalled_nodes() poll")
+
 RUN_DIR_ENV = "MXTPU_RUN_DIR"
 _HB_PREFIX = "hb_"
 _PROG_PREFIX = "prog_"
@@ -54,6 +83,7 @@ class HeartbeatWriter:
 
     def __init__(self, directory, rank, interval=2.0):
         self._dir = directory
+        self.rank = int(rank)
         self._path = os.path.join(directory, "%s%d" % (_HB_PREFIX, rank))
         self._prog_path = os.path.join(
             directory, "%s%d" % (_PROG_PREFIX, rank))
@@ -119,14 +149,19 @@ def dead_nodes(directory, num_workers, timeout=60.0, now=None,
     heartbeat counts as dead (the reference's scheduler likewise treats
     an unregistered-but-expected node as not alive)."""
     now = time.time() if now is None else now
+    record = _tm.enabled() and prefix == _HB_PREFIX
     dead = []
     for rank in range(int(num_workers)):
         path = os.path.join(directory, "%s%d" % (prefix, rank))
         try:
             age = now - os.path.getmtime(path)
         except OSError:
+            if record:
+                _G_HB_AGE.set(float("inf"), rank=str(rank))
             dead.append(rank)
             continue
+        if record:
+            _G_HB_AGE.set(age, rank=str(rank))
         if age > timeout:
             dead.append(rank)
     return dead
@@ -148,8 +183,11 @@ def stalled_nodes(directory, num_workers, timeout, now=None):
     for rank in sorted(alive):
         path = os.path.join(directory, "%s%d" % (_PROG_PREFIX, rank))
         try:
-            if now - os.path.getmtime(path) > timeout:
-                stalled.append(rank)
+            age = now - os.path.getmtime(path)
         except OSError:
             continue  # never progressed yet -> startup, not a stall
+        if _tm.enabled():
+            _G_PROG_AGE.set(age, rank=str(rank))
+        if age > timeout:
+            stalled.append(rank)
     return stalled

@@ -14,8 +14,16 @@ key sharding. Mesh axes follow the scaling-book convention:
 from __future__ import annotations
 
 import os
+import time as _time_mod
 
 import numpy as np
+
+from .. import telemetry as _tm
+
+_H_COLLECTIVE_SECONDS = _tm.histogram(
+    "parallel.collective_seconds",
+    "Host-observed latency of explicit cross-process collectives "
+    "(labelled by op: barrier / allreduce_sum / broadcast)")
 
 
 def device_count():
@@ -73,7 +81,11 @@ def barrier(tag="mxnet-tpu-barrier"):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(tag)
+        with _tm.span("mesh.barrier", tag=tag):
+            t0 = _time_mod.perf_counter()
+            multihost_utils.sync_global_devices(tag)
+            _H_COLLECTIVE_SECONDS.observe(
+                _time_mod.perf_counter() - t0, op="barrier")
 
 
 _ALLREDUCE_CACHE = {}
@@ -124,10 +136,15 @@ def allreduce_sum(value):
         _ALLREDUCE_CACHE[key] = (in_sharding, fn)
     in_sharding, fn = _ALLREDUCE_CACHE[key]
     # exact sum: the value rides row 0, the other local rows are zeros
-    local = np.zeros((nloc,) + value.shape, value.dtype)
-    local[0] = value
-    garr = jax.make_array_from_process_local_data(in_sharding, local)
-    return np.asarray(fn(garr).addressable_data(0))
+    with _tm.span("mesh.allreduce_sum", nbytes=value.nbytes):
+        t0 = _time_mod.perf_counter()
+        local = np.zeros((nloc,) + value.shape, value.dtype)
+        local[0] = value
+        garr = jax.make_array_from_process_local_data(in_sharding, local)
+        out = np.asarray(fn(garr).addressable_data(0))
+        _H_COLLECTIVE_SECONDS.observe(
+            _time_mod.perf_counter() - t0, op="allreduce_sum")
+    return out
 
 
 def broadcast_from_root(value):
@@ -143,7 +160,11 @@ def broadcast_from_root(value):
         return value
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.broadcast_one_to_all(value))
+    t0 = _time_mod.perf_counter()
+    out = np.asarray(multihost_utils.broadcast_one_to_all(value))
+    _H_COLLECTIVE_SECONDS.observe(
+        _time_mod.perf_counter() - t0, op="broadcast")
+    return out
 
 
 def init_distributed(coordinator_address=None, num_processes=None,

@@ -27,6 +27,12 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import telemetry as _tm
+
+_M_STEPS = _tm.counter(
+    "train_step.steps", "Optimizer steps dispatched through the fused "
+    "ShardedTrainStep path")
+
 
 class _EveryKeyCount(dict):
     """Stand-in for Optimizer._index_update_count during tracing: every
@@ -392,9 +398,11 @@ class ShardedTrainStep:
             rngs = jnp.stack([_random.next_key() for _ in range(k)])
         else:
             rngs = jnp.zeros((k, 2), jnp.uint32)
-        return fn(params, aux, opt_state, batches, rngs,
-                  jnp.asarray(lrs, jnp.float32),
-                  jnp.asarray(ts, jnp.float32))
+        _M_STEPS.inc(k, path="multi")
+        with _tm.span("train_step.dispatch", k=k):
+            return fn(params, aux, opt_state, batches, rngs,
+                      jnp.asarray(lrs, jnp.float32),
+                      jnp.asarray(ts, jnp.float32))
 
     def __call__(self, params, aux, opt_state, batch, rng=None, lr=None, t=1):
         assert self._step is not None, "call compile() first"
@@ -428,7 +436,9 @@ class ShardedTrainStep:
                 rng = _random.next_key()
             else:
                 rng = jnp.zeros((2,), jnp.uint32)  # unused placeholder
-        return self._step(
-            params, aux, opt_state, batch, rng,
-            jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.float32),
-        )
+        _M_STEPS.inc(path="single")
+        with _tm.span("train_step.dispatch", t=t):
+            return self._step(
+                params, aux, opt_state, batch, rng,
+                jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.float32),
+            )

@@ -9,6 +9,7 @@ OprExecStat records amounted to.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -19,6 +20,7 @@ _state = {
     "filename": "profile.json",
     "running": False,
     "events": [],
+    "dirty": False,  # events recorded since the last dump
     "jax_tracing": False,
     "jax_dir": None,
 }
@@ -38,6 +40,9 @@ def profiler_set_state(state="stop"):
     if state == "run":
         _state["running"] = True
         _state["t0"] = time.time()
+        with _lock:
+            _state["events"] = []  # fresh session
+            _state["dirty"] = False
         # device-side trace via jax profiler when a trace dir is configured
         trace_dir = os.environ.get("MXNET_TPU_JAX_TRACE_DIR")
         if trace_dir:
@@ -49,35 +54,42 @@ def profiler_set_state(state="stop"):
         if _state.get("jax_tracing"):
             jax.profiler.stop_trace()
             _state["jax_tracing"] = False
+        # auto-flush: stopping a session writes the trace without a
+        # separate dump_profile() call (which stays available and
+        # idempotent — events are only cleared when a new run starts)
+        if _state["dirty"]:
+            dump_profile()
     else:
         raise ValueError("state must be 'run' or 'stop'")
 
 
-def record_event(name, begin_us, end_us, category="operator", pid=0):
-    """Host-side event recording hook (OprExecStat equivalent)."""
+def record_event_complete(name, ts_us, dur_us, category="operator", pid=0,
+                          args=None):
+    """Record one complete chrome-trace ``"X"`` event (ts + dur), the
+    form every consumer (chrome://tracing, perfetto, trace_summary)
+    pairs for free — unpaired B/E records break on dropped ends."""
     if not _state["running"]:
         return
+    event = {
+        "name": name,
+        "cat": category,
+        "ph": "X",
+        "ts": ts_us,
+        "dur": dur_us,
+        "pid": pid,
+        "tid": threading.get_ident() % 10000,
+    }
+    if args:
+        event["args"] = {k: str(v) for k, v in args.items()}
     with _lock:
-        _state["events"].append(
-            {
-                "name": name,
-                "cat": category,
-                "ph": "B",
-                "ts": begin_us,
-                "pid": pid,
-                "tid": threading.get_ident() % 10000,
-            }
-        )
-        _state["events"].append(
-            {
-                "name": name,
-                "cat": category,
-                "ph": "E",
-                "ts": end_us,
-                "pid": pid,
-                "tid": threading.get_ident() % 10000,
-            }
-        )
+        _state["events"].append(event)
+        _state["dirty"] = True
+
+
+def record_event(name, begin_us, end_us, category="operator", pid=0):
+    """Host-side event recording hook (OprExecStat equivalent)."""
+    record_event_complete(name, begin_us, end_us - begin_us,
+                          category=category, pid=pid)
 
 
 class scope:
@@ -96,10 +108,14 @@ class scope:
 
 
 def dump_profile():
-    """Parity MXDumpProfile — writes chrome trace-event JSON."""
+    """Parity MXDumpProfile — writes chrome trace-event JSON.
+
+    Idempotent: events persist until the next profiler_set_state("run")
+    starts a fresh session, so stop's auto-flush and an explicit dump
+    write the same file."""
     with _lock:
-        events = list(_state["events"])
-        _state["events"] = []
+        events = sorted(_state["events"], key=lambda e: e["ts"])
+        _state["dirty"] = False
     trace = {
         "traceEvents": [
             {
@@ -114,6 +130,17 @@ def dump_profile():
     }
     with open(_state["filename"], "w") as f:
         json.dump(trace, f)
+
+
+@atexit.register
+def _dump_at_exit():
+    """Flush undumped events at interpreter exit so a run that never
+    reached profiler_set_state("stop") still leaves its trace."""
+    if _state["dirty"]:
+        try:
+            dump_profile()
+        except OSError:
+            pass  # target dir may be gone during teardown
 
 
 # jax passthroughs for device-side profiling

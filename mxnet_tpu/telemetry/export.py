@@ -1,0 +1,170 @@
+"""Telemetry exporters: structured JSONL + Prometheus text dump.
+
+Two sinks, both optional and env-driven so production jobs opt in
+without code changes:
+
+- ``MXTPU_TELEMETRY_FILE`` — every span appends one JSON line as it
+  closes; ``flush_metrics()`` (called per fit epoch, on ``flush()``, and
+  at interpreter exit) appends a full ``{"type": "metrics"}`` registry
+  snapshot. ``tools/trace_summary.py`` reads this format.
+- ``MXTPU_TELEMETRY_PROM_FILE`` — ``render_prometheus()`` text written
+  on every flush, and periodically (every
+  ``MXTPU_TELEMETRY_PROM_INTERVAL`` seconds, default 30) by a daemon
+  thread, for a node-exporter-style textfile collector to scrape.
+
+Also home of the per-step device gauges: ``sample_device_memory()``
+reads ``jax.local_devices()[...].memory_stats()`` into
+``device.memory.*`` gauges (a no-op on backends without memory stats,
+e.g. CPU).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+from . import registry as _reg
+
+_lock = threading.Lock()
+_jsonl_path = os.environ.get("MXTPU_TELEMETRY_FILE") or None
+_jsonl_fh = None
+_prom_path = os.environ.get("MXTPU_TELEMETRY_PROM_FILE") or None
+_prom_interval = float(os.environ.get("MXTPU_TELEMETRY_PROM_INTERVAL", "30"))
+_prom_thread = None
+_prom_stop = threading.Event()
+
+
+def jsonl_path():
+    return _jsonl_path
+
+
+def set_jsonl_path(path):
+    """Point (or stop, with None) the JSONL sink at ``path``."""
+    global _jsonl_path, _jsonl_fh
+    with _lock:
+        if _jsonl_fh is not None:
+            try:
+                _jsonl_fh.close()
+            except OSError:
+                pass
+            _jsonl_fh = None
+        _jsonl_path = path or None
+
+
+def _fh():
+    """Open the JSONL sink lazily (caller holds _lock)."""
+    global _jsonl_fh
+    if _jsonl_fh is None and _jsonl_path is not None:
+        _jsonl_fh = open(_jsonl_path, "a")
+    return _jsonl_fh
+
+
+def emit_span(record):
+    if _jsonl_path is None:
+        return
+    line = json.dumps(record)
+    with _lock:
+        fh = _fh()
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+
+def flush_metrics():
+    """Append a registry snapshot to the JSONL sink and rewrite the
+    Prometheus file, whichever are configured."""
+    if _jsonl_path is not None:
+        line = json.dumps({
+            "type": "metrics", "ts": time.time(),
+            "metrics": _reg.snapshot(),
+        })
+        with _lock:
+            fh = _fh()
+            if fh is not None:
+                fh.write(line + "\n")
+                fh.flush()
+    write_prometheus_file()
+
+
+def set_prometheus_file(path, interval=None):
+    """Configure the Prometheus text sink; interval > 0 starts the
+    periodic writer thread."""
+    global _prom_path, _prom_interval
+    _prom_path = path or None
+    if interval is not None:
+        _prom_interval = float(interval)
+    if _prom_path is not None and _prom_interval > 0:
+        _start_prom_thread()
+
+
+def write_prometheus_file():
+    if _prom_path is None:
+        return
+    text = _reg.render_prometheus()
+    tmp = _prom_path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, _prom_path)  # atomic vs a concurrent scraper
+    except OSError:
+        pass  # export is advisory; never take training down
+
+
+def _start_prom_thread():
+    global _prom_thread
+    if _prom_thread is not None and _prom_thread.is_alive():
+        return
+    _prom_stop.clear()
+
+    def _loop():
+        while not _prom_stop.wait(_prom_interval):
+            if _reg._enabled:
+                write_prometheus_file()
+
+    _prom_thread = threading.Thread(
+        target=_loop, name="mxtpu-telemetry-prom", daemon=True)
+    _prom_thread.start()
+
+
+def stop_prom_thread():
+    _prom_stop.set()
+
+
+# -- device memory gauges ---------------------------------------------
+def sample_device_memory():
+    """Read each local device's memory_stats() into gauges. Safe to call
+    per step: backends without stats (CPU) return None and are skipped."""
+    if not _reg._enabled:
+        return
+    import jax
+
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend-dependent surface
+            stats = None
+        if not stats:
+            continue
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                _reg.gauge("device.memory." + key).set(
+                    stats[key], device=str(dev.id))
+
+
+def _at_exit():
+    """Interpreter-exit flush: a crashed-after-N-epochs run still leaves
+    its last metrics snapshot on disk."""
+    if _reg._enabled and (_jsonl_path is not None or _prom_path is not None):
+        try:
+            flush_metrics()
+        except Exception:  # noqa: BLE001 — exit path must not raise
+            pass
+
+
+atexit.register(_at_exit)
+
+if (_prom_path is not None and _prom_interval > 0
+        and os.environ.get("MXTPU_TELEMETRY_PROM_FILE")):
+    _start_prom_thread()

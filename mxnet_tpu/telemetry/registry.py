@@ -1,0 +1,291 @@
+"""Process-wide metrics registry: counters, gauges, histograms with labels.
+
+The substrate the tentpole layers (engine, executor, module, kvstore,
+parallel) instrument against. Design constraints, in order:
+
+1. **Disabled means free.** Every mutator starts with one global-flag
+   check and returns; instrument sites can therefore hold module-level
+   metric handles and call them unconditionally on hot paths
+   (``tests/test_telemetry.py`` asserts the disabled fast path with a
+   micro-benchmark).
+2. **Thread-safe.** Engine workers, the comm engine, and the training
+   thread all write concurrently; each metric serializes its own
+   updates under one lock (no global lock on the update path).
+3. **Stdlib only.** This module must be importable before jax (engine
+   imports it at module load) and never joins an import cycle.
+
+Naming follows the framework's dotted convention (``engine.ops_pushed``);
+the Prometheus renderer sanitizes to ``engine_ops_pushed`` at the edge.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+
+# enabled at import via env so `MXTPU_TELEMETRY=1 python train.py` needs
+# no code changes; MXTPU_TELEMETRY_FILE implies enablement (an export
+# destination without collection would silently produce nothing)
+_enabled = (
+    os.environ.get("MXTPU_TELEMETRY", "0") not in ("", "0")
+    or bool(os.environ.get("MXTPU_TELEMETRY_FILE"))
+)
+
+
+def enabled():
+    """Whether collection is on (the flag every mutator guards on)."""
+    return _enabled
+
+
+def set_enabled(flag):
+    global _enabled
+    _enabled = bool(flag)
+
+
+def _label_key(labels):
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+class _Metric:
+    """Base: one named instrument holding per-label-set streams."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values = {}  # label-key tuple -> stream state
+
+    def label_sets(self):
+        with self._lock:
+            return list(self._values.keys())
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (ops pushed, bytes moved, seconds
+    accumulated)."""
+
+    kind = "counter"
+
+    def inc(self, amount=1, **labels):
+        if not _enabled:
+            return
+        if amount < 0:
+            raise ValueError("counter %s: negative increment" % self.name)
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + amount
+
+    def value(self, **labels):
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    """Point-in-time value (queue depth, samples/sec, liveness age)."""
+
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = value
+
+    def inc(self, amount=1, **labels):
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + amount
+
+    def dec(self, amount=1, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels):
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
+
+
+# latency-shaped default: 500us .. 30s, the range framework step/compile
+# times actually land in
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Histogram(_Metric):
+    """Bucketed distribution (step latency, push/pull time)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value, **labels):
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            state = self._values.get(key)
+            if state is None:
+                state = {"counts": [0] * (len(self.buckets) + 1),
+                         "sum": 0.0, "count": 0}
+                self._values[key] = state
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    state["counts"][i] += 1
+                    break
+            else:
+                state["counts"][-1] += 1  # +Inf bucket
+            state["sum"] += value
+            state["count"] += 1
+
+    def count(self, **labels):
+        with self._lock:
+            state = self._values.get(_label_key(labels))
+            return state["count"] if state else 0
+
+    def sum(self, **labels):
+        with self._lock:
+            state = self._values.get(_label_key(labels))
+            return state["sum"] if state else 0.0
+
+
+class Registry:
+    """Name -> metric map with get-or-create accessors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    "metric %r already registered as %s, requested %s"
+                    % (name, m.kind, cls.kind))
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self):
+        with self._lock:
+            return list(self._metrics.values())
+
+    def reset_values(self):
+        """Zero every metric IN PLACE: instrument sites hold handles, so
+        dropping registrations (rather than clearing) would silently
+        detach them from future renders."""
+        for m in self.metrics():
+            m.clear()
+
+    # -- snapshots -----------------------------------------------------
+    def snapshot(self):
+        """Plain-data dump for the JSONL exporter: name -> kind + per
+        label-set values."""
+        out = {}
+        for m in self.metrics():
+            streams = []
+            with m._lock:
+                items = list(m._values.items())
+            for key, val in items:
+                labels = dict(key)
+                if m.kind == "histogram":
+                    streams.append({"labels": labels, "sum": val["sum"],
+                                    "count": val["count"],
+                                    "counts": list(val["counts"])})
+                else:
+                    streams.append({"labels": labels, "value": val})
+            out[m.name] = {"kind": m.kind, "streams": streams}
+        return out
+
+    def render_prometheus(self):
+        """Prometheus text exposition (0.0.4) of every metric."""
+        lines = []
+        for m in sorted(self.metrics(), key=lambda m: m.name):
+            name = _prom_name(m.name)
+            if m.help:
+                lines.append("# HELP %s %s" % (name, m.help))
+            lines.append("# TYPE %s %s" % (name, m.kind))
+            with m._lock:
+                items = sorted(m._values.items())
+            for key, val in items:
+                if m.kind == "histogram":
+                    cum = 0
+                    for i, b in enumerate(m.buckets):
+                        cum += val["counts"][i]
+                        lines.append("%s_bucket%s %d" % (
+                            name, _prom_labels(key, le=_prom_float(b)), cum))
+                    cum += val["counts"][-1]
+                    lines.append("%s_bucket%s %d" % (
+                        name, _prom_labels(key, le="+Inf"), cum))
+                    lines.append("%s_sum%s %s" % (
+                        name, _prom_labels(key), _prom_float(val["sum"])))
+                    lines.append("%s_count%s %d" % (
+                        name, _prom_labels(key), val["count"]))
+                else:
+                    lines.append("%s%s %s" % (
+                        name, _prom_labels(key), _prom_float(val)))
+        return "\n".join(lines) + "\n"
+
+
+def _prom_name(name):
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(out)
+    return "mxtpu_" + s if not s.startswith("mxtpu_") else s
+
+
+def _prom_float(v):
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+    return str(v)
+
+
+def _prom_labels(key, **extra):
+    pairs = list(key) + sorted(extra.items())
+    if not pairs:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in pairs)
+    return "{%s}" % body
+
+
+REGISTRY = Registry()
+
+# module-level conveniences bound to the process registry
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+render_prometheus = REGISTRY.render_prometheus
+snapshot = REGISTRY.snapshot

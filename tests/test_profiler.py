@@ -28,6 +28,47 @@ def test_chrome_trace_roundtrip(tmp_path):
     assert any(e.get("name") == "unit_op" for e in events)
 
 
+def test_chrome_trace_complete_events(tmp_path):
+    # events are complete "X" records (ts + dur), not unpaired B/E —
+    # every consumer pairs them for free, dropped ends can't corrupt
+    fn = str(tmp_path / "prof.json")
+    profiler.profiler_set_config(mode="all", filename=fn)
+    profiler.profiler_set_state("run")
+    profiler.record_event_complete("op_a", 1000.0, 250.0,
+                                   args={"step": 3})
+    with profiler.scope("op_b"):
+        pass
+    profiler.profiler_set_state("stop")
+    events = json.load(open(fn))["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert {e["name"] for e in xs} == {"op_a", "op_b"}
+    a = next(e for e in xs if e["name"] == "op_a")
+    assert a["ts"] == 1000.0 and a["dur"] == 250.0
+    assert a["args"] == {"step": "3"}
+    assert not any(e.get("ph") in ("B", "E") for e in events)
+    # ts monotonic non-decreasing (dump sorts)
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+
+
+def test_profiler_auto_flush_on_stop(tmp_path):
+    # stop writes the trace without an explicit dump_profile() call
+    fn = str(tmp_path / "auto.json")
+    profiler.profiler_set_config(mode="all", filename=fn)
+    profiler.profiler_set_state("run")
+    profiler.record_event("auto_op", 0.0, 10.0)
+    profiler.profiler_set_state("stop")
+    events = json.load(open(fn))["traceEvents"]
+    assert any(e.get("name") == "auto_op" for e in events)
+    # a fresh run session clears the previous events
+    profiler.profiler_set_state("run")
+    profiler.record_event("second_op", 0.0, 5.0)
+    profiler.profiler_set_state("stop")
+    names = {e.get("name")
+             for e in json.load(open(fn))["traceEvents"]}
+    assert "second_op" in names and "auto_op" not in names
+
+
 def test_hlo_metadata_map_parses_both_layouts():
     # TPU layout: inline source_file/source_line; CPU layout:
     # stack_frame_id only. Both must parse (source degrades to "?").

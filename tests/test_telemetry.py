@@ -1,0 +1,388 @@
+"""Telemetry subsystem: registry semantics, span tracing, exporters,
+and the end-to-end observability smoke (tiny Module.fit producing a
+chrome trace with nested framework spans plus JSONL/Prometheus metrics).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.model import BatchEndParam
+from mxnet_tpu.models import mlp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Zero metric values and detach sinks around every test (handles
+    held by instrument sites stay registered)."""
+    tm.reset()
+    tm.disable()
+    yield
+    tm.reset()
+    tm.disable()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    tm.enable()
+    c = tm.counter("t.requests", "test counter")
+    c.inc()
+    c.inc(4, route="a")
+    assert c.value() == 1
+    assert c.value(route="a") == 4
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = tm.gauge("t.depth", "test gauge")
+    g.set(7)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 8
+
+    h = tm.histogram("t.latency", "test histogram")
+    for v in (0.001, 0.2, 5.0):
+        h.observe(v)
+    assert h.count() == 3
+    assert abs(h.sum() - 5.201) < 1e-9
+
+
+def test_same_name_returns_same_instance_and_kind_conflicts():
+    a = tm.counter("t.shared", "one")
+    b = tm.counter("t.shared", "one")
+    assert a is b
+    with pytest.raises(TypeError):
+        tm.gauge("t.shared", "not a counter")
+
+
+def test_counter_threaded_exactness():
+    tm.enable()
+    c = tm.counter("t.threads", "threaded counter")
+    n_threads, per = 8, 5000
+
+    def work():
+        for _ in range(per):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == n_threads * per
+
+
+def test_disabled_is_guarded_noop():
+    # disabled mutators must drop the sample AND be near-free: one flag
+    # check, no locking, no label hashing
+    c = tm.counter("t.off", "disabled counter")
+    h = tm.histogram("t.off_lat", "disabled histogram")
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        c.inc()
+        h.observe(0.5)
+    dt = time.perf_counter() - t0
+    assert c.value() == 0
+    assert h.count() == 0
+    assert dt < 0.5, "disabled fast path too slow: %.3fs / 100k" % dt
+    assert tm.span("t.noop") is tm.span("t.other")  # shared null span
+
+
+def test_render_prometheus_exposition():
+    tm.enable()
+    tm.counter("t.bytes", "byte counter").inc(10, direction="tx")
+    h = tm.histogram("t.h", "hist")
+    h.observe(0.0007)
+    h.observe(100.0)  # lands in +Inf only
+    text = tm.render_prometheus()
+    assert '# HELP mxtpu_t_bytes byte counter' in text
+    assert '# TYPE mxtpu_t_bytes counter' in text
+    assert 'mxtpu_t_bytes{direction="tx"} 10' in text
+    assert '# TYPE mxtpu_t_h histogram' in text
+    assert 'mxtpu_t_h_count 2' in text
+    # buckets are cumulative and +Inf equals _count
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("mxtpu_t_h_bucket")]
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts)
+    assert counts[-1] == 2.0
+    assert 'le="+Inf"' in lines[-1]
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_chrome_trace(tmp_path):
+    tm.enable()
+    fn = str(tmp_path / "spans.json")
+    profiler.profiler_set_config(mode="all", filename=fn)
+    profiler.profiler_set_state("run")
+    with tm.span("outer", step=1) as outer:
+        assert tm.current_span() is outer
+        assert outer.depth == 0
+        with tm.span("inner") as inner:
+            assert inner.parent is outer
+            assert inner.depth == 1
+            time.sleep(0.002)
+    assert tm.current_span() is None
+    profiler.profiler_set_state("stop")
+
+    events = json.load(open(fn))["traceEvents"]
+    xs = {e["name"]: e for e in events if e.get("ph") == "X"}
+    assert set(xs) >= {"outer", "inner"}
+    o, i = xs["outer"], xs["inner"]
+    assert o["cat"] == "framework"
+    # child temporally contained in parent (the property chrome://tracing
+    # uses to nest X events on one tid)
+    eps = 1.0  # µs
+    assert i["ts"] >= o["ts"] - eps
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + eps
+    assert i["args"]["parent"] == "outer"
+    # dump sorts: ts monotonic non-decreasing
+    ts = [e["ts"] for e in events if e.get("ph") == "X"]
+    assert ts == sorted(ts)
+    # spans also aggregate into the span_seconds histogram
+    snap = tm.snapshot()["mxtpu.span_seconds"]
+    spans = {s["labels"]["span"] for s in snap["streams"]}
+    assert {"outer", "inner"} <= spans
+
+
+def test_spans_are_thread_local():
+    tm.enable()
+    depths = {}
+
+    def work(key):
+        with tm.span("worker-%s" % key) as s:
+            time.sleep(0.005)
+            depths[key] = s.depth
+
+    with tm.span("main-open"):
+        ts = [threading.Thread(target=work, args=(k,)) for k in "ab"]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    # other threads' spans never nest under this thread's open span
+    assert depths == {"a": 0, "b": 0}
+
+
+def test_span_error_is_recorded(tmp_path):
+    tm.enable(jsonl=str(tmp_path / "err.jsonl"))
+    with pytest.raises(RuntimeError):
+        with tm.span("boom"):
+            raise RuntimeError("nope")
+    rec = json.loads(open(tm.jsonl_path()).readline())
+    assert rec["name"] == "boom"
+    assert rec["attrs"]["error"] == "RuntimeError"
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_jsonl_and_prometheus_file_export(tmp_path):
+    jsonl = str(tmp_path / "t.jsonl")
+    prom = str(tmp_path / "t.prom")
+    tm.enable(jsonl=jsonl, prometheus=prom, prometheus_interval=3600)
+    tm.counter("t.flushed", "c").inc(5)
+    with tm.span("exported"):
+        pass
+    tm.flush()
+    lines = [json.loads(ln) for ln in open(jsonl)]
+    kinds = [ln["type"] for ln in lines]
+    assert "span" in kinds and "metrics" in kinds
+    span = next(ln for ln in lines if ln["type"] == "span")
+    assert span["name"] == "exported" and span["dur"] >= 0
+    metrics = next(ln for ln in lines if ln["type"] == "metrics")
+    streams = metrics["metrics"]["t.flushed"]["streams"]
+    assert streams[0]["value"] == 5
+    assert "mxtpu_t_flushed 5" in open(prom).read()
+
+
+def test_sample_device_memory_no_crash():
+    tm.enable()
+    tm.sample_device_memory()  # CPU backend may expose no stats: no-op
+
+
+# ---------------------------------------------------------------------------
+# Speedometer / Monitor satellites
+# ---------------------------------------------------------------------------
+
+def test_throughput_math_and_speedometer_gauge(monkeypatch):
+    class _FakeTime:
+        t = 1000.0
+
+        @classmethod
+        def time(cls):
+            return cls.t
+
+    monkeypatch.setattr(mx.callback, "time", _FakeTime)
+    tm.enable()
+
+    meter = mx.callback._Throughput(batch_size=10, frequent=2)
+    assert meter.sample(0) is None  # arms the window
+    _FakeTime.t = 1001.0
+    assert meter.sample(1) is None  # off-period
+    _FakeTime.t = 1002.0
+    assert meter.sample(2) == pytest.approx(10 * 2 / 2.0)  # 10 samples/s
+    # epoch rollover restarts the window instead of emitting garbage
+    _FakeTime.t = 1003.0
+    assert meter.sample(0) is None
+
+    speedo = mx.callback.Speedometer(batch_size=4, frequent=2)
+    _FakeTime.t = 2000.0
+    speedo(BatchEndParam(epoch=0, nbatch=0, eval_metric=None, locals={}))
+    _FakeTime.t = 2002.0
+    speedo(BatchEndParam(epoch=0, nbatch=2, eval_metric=None, locals={}))
+    # 2 batches x 4 samples over 2s -> 4 samples/sec, mirrored to a gauge
+    assert tm.gauge("fit.samples_per_sec").value() == pytest.approx(4.0)
+
+
+def test_monitor_pattern_and_sort():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    exe = net.simple_bind(ctx=mx.cpu(0), data=(4, 8), softmax_label=(4,))
+    exe.arg_dict["data"][:] = np.random.randn(4, 8).astype("f")
+
+    # is_train=False: a training forward defers the launch (and with it
+    # the monitor stream) until backward() fuses fwd+bwd
+    mon = mx.monitor.Monitor(interval=1, pattern="fc1_.*", sort=True)
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False)
+    records = mon.toc()
+    names = [name for _step, name, _stat in records]
+    # regex filter: only fc1's weights match (outputs stream under the
+    # head name "softmax_output"), nothing from fc2
+    assert names == ["fc1_bias", "fc1_weight"]  # sort=True: by name
+
+    mon2 = mx.monitor.Monitor(interval=1, pattern=".*", sort=False)
+    mon2.install(exe)
+    mon2.tic()
+    exe.forward(is_train=False)
+    names2 = [n for _s, n, _v in mon2.toc()]
+    assert "softmax_output" in names2 and "fc2_weight" in names2
+    # unsorted: op outputs stream in before the toc-time weight pass
+    assert names2.index("softmax_output") < names2.index("fc1_weight")
+
+
+# ---------------------------------------------------------------------------
+# engine metrics
+# ---------------------------------------------------------------------------
+
+def test_engine_counters():
+    tm.enable()
+    eng = mx.engine.comm()
+    pushed0 = tm.counter("engine.ops_pushed").value()
+    done0 = tm.counter("engine.ops_executed").value()
+    ran = []
+    var = eng.new_variable()
+    for _ in range(5):
+        eng.push(lambda: ran.append(1), mutable_vars=[var])
+    eng.wait_for_all()
+    assert len(ran) == 5
+    assert tm.counter("engine.ops_pushed").value() - pushed0 == 5
+    assert tm.counter("engine.ops_executed").value() - done0 == 5
+    assert tm.histogram("engine.op_seconds").count() >= 5
+
+
+# ---------------------------------------------------------------------------
+# end-to-end smoke: tiny fit -> trace + JSONL/Prometheus (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_fit_telemetry_smoke(tmp_path):
+    jsonl = str(tmp_path / "telemetry.jsonl")
+    prom = str(tmp_path / "metrics.prom")
+    trace = str(tmp_path / "profile.json")
+    tm.enable(jsonl=jsonl, prometheus=prom, prometheus_interval=3600)
+    profiler.profiler_set_config(mode="all", filename=trace)
+    profiler.profiler_set_state("run")
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("f")
+    y = (rng.rand(64) > 0.5).astype("f")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(mlp(num_classes=2, hidden=(8,)))
+    # an explicit KVStore instance routes update_on_kvstore=True, so the
+    # step does real push/pull (string 'local' on 1 device drops the kv)
+    kv = mx.kvstore.create("local")
+    mod.fit(it, optimizer="sgd", kvstore=kv, num_epoch=1)
+
+    profiler.profiler_set_state("stop")
+    tm.flush()
+
+    # (a) chrome trace: framework spans present and nested
+    events = json.load(open(trace))["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    by_name = {}
+    for e in xs:
+        by_name.setdefault(e["name"], []).append(e)
+    assert len(by_name.get("fit.step", [])) == 4  # 64/16 batches
+    assert "module.update" in by_name
+    step = by_name["fit.step"][0]
+    upd = by_name["module.update"][0]
+    assert upd["ts"] >= step["ts"] - 1.0
+    assert upd["ts"] + upd["dur"] <= step["ts"] + step["dur"] + 1.0
+    assert upd["args"]["parent"] == "fit.step"
+
+    # (b) exported metrics: compile/cache/step-latency/kvstore bytes all
+    # nonzero after one epoch
+    def total(name, kind="counter"):
+        streams = tm.snapshot()[name]["streams"]
+        if kind == "histogram":
+            return sum(s["count"] for s in streams)
+        return sum(s["value"] for s in streams)
+
+    assert total("executor.jit_compile_count") >= 1
+    assert total("executor.jit_compile_seconds") > 0
+    assert total("executor.fn_cache_misses") >= 1
+    assert total("executor.fn_cache_hits") >= 1  # steps 2..4 hit
+    assert total("executor.step_seconds", "histogram") >= 4
+    assert total("fit.step_seconds", "histogram") == 4
+    assert total("kvstore.push_bytes") > 0
+    assert total("kvstore.pull_bytes") > 0
+    assert total("kvstore.push_seconds", "histogram") >= 1
+    assert total("engine.ops_pushed") >= 1
+
+    # the same numbers must round-trip through both exporters
+    lines = [json.loads(ln) for ln in open(jsonl)]
+    metrics = [ln for ln in lines if ln["type"] == "metrics"][-1]["metrics"]
+    assert any(s["value"] > 0 for s in
+               metrics["kvstore.push_bytes"]["streams"])
+    spans = {ln["name"] for ln in lines if ln["type"] == "span"}
+    assert "fit.step" in spans
+    prom_text = open(prom).read()
+    assert "mxtpu_executor_jit_compile_seconds" in prom_text
+    assert "mxtpu_fit_step_seconds_bucket" in prom_text
+
+    # trace_summary reads both artifacts
+    from tools import trace_summary
+
+    out = trace_summary.summarize(trace)
+    assert "fit.step" in out
+    out = trace_summary.summarize(jsonl)
+    assert "fit.step" in out and "kvstore.push_bytes" in out
+
+
+def test_trace_summary_cli_self_test():
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.trace_summary", "--self-test"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "self-test passed" in res.stdout

@@ -1,0 +1,6 @@
+"""Operational tools (launchers, converters, trace analysis).
+
+Package __init__ so tools run as modules too: e.g.
+``python -m tools.trace_summary profile.json``. Scripts keep working
+when invoked by path (each guards with ``__main__``).
+"""

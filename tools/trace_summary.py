@@ -1,0 +1,202 @@
+"""Summarize telemetry output: chrome traces and telemetry JSONL.
+
+Reads either artifact the framework's observability stack produces —
+
+* a chrome trace-event JSON (``profiler.dump_profile`` output, also any
+  jax.profiler ``*.trace.json``) — complete ``"X"`` events are grouped
+  by name;
+* a telemetry JSONL stream (``MXTPU_TELEMETRY_FILE`` /
+  ``telemetry.enable(jsonl=...)``) — ``span`` lines are grouped by
+  name, and the LAST ``metrics`` snapshot is rendered below the table.
+
+For each span/event name: count, total ms, mean ms, and share of wall
+time (first start to last end). Usage::
+
+    python -m tools.trace_summary profile.json
+    python -m tools.trace_summary telemetry.jsonl --top 15
+    python -m tools.trace_summary --self-test
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _rows_from_events(events):
+    """(name, total_us, count) rows + wall µs from chrome 'X' events."""
+    agg = {}
+    t_min, t_max = None, None
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        tot, cnt = agg.get(name, (0.0, 0))
+        agg[name] = (tot + dur, cnt + 1)
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+    wall = (t_max - t_min) if agg else 0.0
+    return [(n, t, c) for n, (t, c) in agg.items()], wall
+
+
+def _rows_from_jsonl(lines):
+    """Span rows + wall µs + last metrics snapshot from telemetry JSONL."""
+    agg = {}
+    t_min, t_max = None, None
+    metrics = None
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn trailing line (live file)
+        if rec.get("type") == "metrics":
+            metrics = rec.get("metrics")
+            continue
+        if rec.get("type") != "span":
+            continue
+        ts = float(rec.get("ts", 0.0)) * 1e6
+        dur = float(rec.get("dur", 0.0)) * 1e6
+        name = rec.get("name", "?")
+        tot, cnt = agg.get(name, (0.0, 0))
+        agg[name] = (tot + dur, cnt + 1)
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+    wall = (t_max - t_min) if agg else 0.0
+    return [(n, t, c) for n, (t, c) in agg.items()], wall, metrics
+
+
+def load(path):
+    """Returns (rows, wall_us, metrics_or_None). Sniffs the format: a
+    JSON document with 'traceEvents' is a chrome trace, anything else is
+    treated as JSONL."""
+    with open(path) as f:
+        content = f.read()
+    try:
+        doc = json.loads(content)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        rows, wall = _rows_from_events(doc["traceEvents"])
+        return rows, wall, None
+    return _rows_from_jsonl(content.splitlines())
+
+
+def format_table(rows, wall_us, top=0):
+    rows = sorted(rows, key=lambda r: -r[1])
+    if top:
+        rows = rows[:top]
+    out = ["%-32s %8s %12s %10s %7s" % (
+        "phase", "count", "total ms", "mean ms", "% wall")]
+    out.append("-" * 73)
+    for name, tot, cnt in rows:
+        pct = (100.0 * tot / wall_us) if wall_us else 0.0
+        out.append("%-32s %8d %12.3f %10.3f %6.1f%%" % (
+            name[:32], cnt, tot / 1e3, tot / cnt / 1e3, pct))
+    out.append("wall: %.3f ms" % (wall_us / 1e3))
+    return "\n".join(out)
+
+
+def format_metrics(metrics):
+    out = ["", "metrics (last snapshot):"]
+    for name in sorted(metrics):
+        m = metrics[name]
+        for stream in m.get("streams", []):
+            labels = stream.get("labels") or {}
+            lbl = ",".join("%s=%s" % kv for kv in sorted(labels.items()))
+            suffix = ("{%s}" % lbl) if lbl else ""
+            if m.get("kind") == "histogram":
+                cnt = stream.get("count", 0)
+                tot = stream.get("sum", 0.0)
+                mean = (tot / cnt) if cnt else 0.0
+                val = "count=%d sum=%.6g mean=%.6g" % (cnt, tot, mean)
+            else:
+                val = "%.6g" % stream.get("value", 0.0)
+            out.append("  %-44s %s" % (name + suffix, val))
+    return "\n".join(out)
+
+
+def summarize(path, top=0):
+    rows, wall, metrics = load(path)
+    if not rows and metrics is None:
+        return "no span/event records in %s" % path
+    text = format_table(rows, wall, top=top) if rows else (
+        "no span records in %s" % path)
+    if metrics:
+        text += "\n" + format_metrics(metrics)
+    return text
+
+
+def _self_test():
+    """Exercise both readers on synthetic files; raises on mismatch."""
+    import os
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="trace_summary_test_")
+    # chrome trace: two names, overlapping events
+    trace = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 0, "args": {}},
+        {"name": "fwd", "ph": "X", "ts": 0.0, "dur": 1000.0, "pid": 0},
+        {"name": "fwd", "ph": "X", "ts": 2000.0, "dur": 3000.0, "pid": 0},
+        {"name": "bwd", "ph": "X", "ts": 1000.0, "dur": 500.0, "pid": 0},
+    ]}
+    tp = os.path.join(d, "profile.json")
+    with open(tp, "w") as f:
+        json.dump(trace, f)
+    rows, wall, metrics = load(tp)
+    by = {n: (t, c) for n, t, c in rows}
+    assert metrics is None
+    assert by["fwd"] == (4000.0, 2), by
+    assert by["bwd"] == (500.0, 1), by
+    assert wall == 5000.0, wall  # 0 .. 2000+3000
+
+    # telemetry JSONL: spans + a metrics snapshot + a torn line
+    jp = os.path.join(d, "telemetry.jsonl")
+    with open(jp, "w") as f:
+        f.write(json.dumps({"type": "span", "name": "fit.step",
+                            "ts": 10.0, "dur": 0.5}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "fit.step",
+                            "ts": 11.0, "dur": 0.25}) + "\n")
+        f.write(json.dumps({"type": "metrics", "metrics": {
+            "mxtpu.demo": {"kind": "counter",
+                           "streams": [{"labels": {}, "value": 7}]},
+            "mxtpu.lat": {"kind": "histogram",
+                          "streams": [{"labels": {"op": "x"},
+                                       "count": 2, "sum": 0.75}]},
+        }}) + "\n")
+        f.write('{"type": "span", "name": "torn')  # no newline, mid-write
+    rows, wall, metrics = load(jp)
+    by = {n: (t, c) for n, t, c in rows}
+    assert by["fit.step"] == (750000.0, 2), by
+    assert abs(wall - 1.25e6) < 1e-6, wall  # 10.0s .. 11.25s
+    assert metrics["mxtpu.demo"]["streams"][0]["value"] == 7
+    text = summarize(jp)
+    assert "fit.step" in text and "mxtpu.demo" in text, text
+    print("self-test passed")
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Summarize a chrome trace or telemetry JSONL file")
+    parser.add_argument("path", nargs="?",
+                        help="profile.json or telemetry .jsonl")
+    parser.add_argument("--top", type=int, default=0,
+                        help="show only the N most expensive phases")
+    parser.add_argument("--self-test", action="store_true",
+                        help="run built-in checks on synthetic inputs")
+    args = parser.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.path:
+        parser.error("path required (or --self-test)")
+    print(summarize(args.path, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,15 @@ _M_CACHE_MISSES = _tm.counter(
     "compiled-callable cache misses (compiles), by segment key")
 _H_STEP_SECONDS = _tm.histogram(
     "executor.step_seconds", "executor forward / fused fwd+bwd dispatch time")
+_M_PLAN_HITS = _tm.counter(
+    "executor.dispatch_plan_hits",
+    "Steady-state dispatches served from the cached canonicalization "
+    "plan (per-step graph-wide shape resolution and arg-dict churn "
+    "skipped)")
+_M_PLAN_MISSES = _tm.counter(
+    "executor.dispatch_plan_misses",
+    "Dispatch-plan cache misses: a new (shape, dtype, sharding) input "
+    "signature was canonicalized and cached")
 
 
 def _instrument_jit(fn, key):
@@ -190,6 +199,30 @@ class _GraphProgram:
         }
         # stable per-node ids for rng folding
         self._node_ids = {id(n): i for i, n in enumerate(self.nodes)}
+        # (shape, dtype, sharding) input signature -> canonicalized
+        # per-signature dispatch state (see dispatch_plan)
+        self._dispatch_plans = {}
+
+    def dispatch_plan(self, sig, build):
+        """Steady-state dispatch fast path. ``sig`` is the caller's
+        (shape, dtype, sharding) input signature; ``build()`` produces
+        the canonicalized per-signature state — today the resolved
+        creation-op shape overrides (the arg-ordering/donation plan
+        proper lives inside jax.jit, keyed by the same signature).
+        Repeat signatures skip the graph-wide shape re-resolution and
+        the full params+batch dict build/sort that used to run before
+        EVERY dispatch; a shape, dtype, or sharding change (partial
+        final batch, Module.reshape, re-placed inputs) re-canonicalizes
+        exactly once."""
+        plan = self._dispatch_plans.get(sig)
+        if plan is None:
+            _M_PLAN_MISSES.inc()
+            plan = build()
+            self._dispatch_plans[sig] = plan
+        else:
+            _M_PLAN_HITS.inc()
+        self.shape_overrides = plan
+        return plan
 
     def __call__(self, arg_values, aux_values, rng, is_train):
         """arg_values: dict name→jax array; aux_values: dict name→jax array.

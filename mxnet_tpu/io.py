@@ -13,15 +13,23 @@ from __future__ import annotations
 import gzip
 import os
 import struct
-from collections import namedtuple
+import time
+from collections import deque, namedtuple
 
 import numpy as np
 
 from . import ndarray as nd
+from . import telemetry as _tm
 from .base import MXNetError
 from .ndarray import NDArray
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
+
+_H_FEED_WAIT = _tm.histogram(
+    "io.feed_wait_seconds",
+    "Host time DeviceFeedIter.next() spends handing over the staged "
+    "batch and re-filling the pipeline (the device transfers themselves "
+    "are async and overlap compute)")
 
 
 class DataBatch(object):
@@ -254,6 +262,133 @@ class PrefetchingIter(DataIter):
         if self.iter_next():
             return self.current_batch
         raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class DeviceFeedIter(DataIter):
+    """Device-resident double-buffered feed: overlap the host->device
+    batch transfer with device compute (the input stage of the async
+    dispatch pipeline, docs/performance.md).
+
+    Wraps any DataIter and keeps up to ``depth`` upcoming batches'
+    ``jax.device_put`` transfers IN FLIGHT onto ``sharding`` (e.g. a
+    fused trainer's dp-sharded ``batch_sharding()``; for dp×tp meshes
+    ``PartitionSpec('dp')`` shards rows over dp and replicates over the
+    other axes). device_put is async: by the time the consumer finishes
+    computing step i, step i+1's bytes are already resident, and
+    Module's fused path recognizes the placement (sharding equality in
+    ``_make_fused_batch``) and hands the arrays straight to the
+    compiled step — the per-step synchronous asnumpy + device_put
+    disappears from the hot loop.
+
+    The reference's PrefetcherIter (iter_prefetcher.h) overlaps host
+    DECODE with compute; this adds the host->device TRANSFER overlap
+    that TF's input pipelines treat as structural (Abadi et al.,
+    arXiv:1605.08695). Labels ride ``label_sharding`` when given,
+    ``sharding`` otherwise.
+
+    ``BaseModule.fit`` wraps the training iterator automatically when
+    the fused path engages (opt out with MXTPU_DEVICE_FEED=0); wrap
+    manually for custom loops. Not used on multi-process feeds (each
+    process holds only its local rows — make_array_from_process_local_data
+    territory).
+    """
+
+    def __init__(self, data_iter, sharding, label_sharding=None, depth=None):
+        super().__init__()
+        if depth is None:
+            try:
+                depth = int(os.environ.get("MXTPU_FEED_DEPTH", "2"))
+            except ValueError:
+                depth = 2
+        if depth < 1:
+            raise MXNetError("DeviceFeedIter depth must be >= 1, got %d"
+                             % depth)
+        self.iter = data_iter
+        self.depth = depth
+        self._sharding = sharding
+        self._label_sharding = (label_sharding if label_sharding is not None
+                                else sharding)
+        self.batch_size = data_iter.batch_size
+        self._staged = deque()
+        self._exhausted = False
+        self.current_batch = None
+        self._fill()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def _place(self, arr, sharding):
+        import jax
+
+        data = arr._data if isinstance(arr, NDArray) else np.asarray(arr)
+        return NDArray(jax.device_put(data, sharding))
+
+    def _stage_one(self):
+        """Pull one host batch and ENQUEUE its device transfer (async:
+        device_put returns immediately; the copy overlaps compute)."""
+        if self._exhausted:
+            return False
+        try:
+            b = self.iter.next()
+        except StopIteration:
+            self._exhausted = True
+            return False
+        self._staged.append(DataBatch(
+            data=[self._place(a, self._sharding) for a in (b.data or [])],
+            label=[self._place(a, self._label_sharding)
+                   for a in (b.label or [])],
+            pad=b.pad, index=b.index, bucket_key=b.bucket_key,
+            provide_data=b.provide_data, provide_label=b.provide_label,
+        ))
+        return True
+
+    def _fill(self):
+        while len(self._staged) < self.depth and self._stage_one():
+            pass
+
+    def reset(self):
+        # staged transfers are abandoned, not awaited: jax arrays are
+        # immutable, so dropping the references mid-flight is safe
+        self._staged.clear()
+        self._exhausted = False
+        self.current_batch = None
+        self.iter.reset()
+        self._fill()
+
+    def next(self):
+        t0 = time.perf_counter()
+        if not self._staged:
+            self._fill()
+        if not self._staged:
+            raise StopIteration
+        self.current_batch = self._staged.popleft()
+        self._fill()  # keep `depth` transfers in flight
+        _H_FEED_WAIT.observe(time.perf_counter() - t0)
+        return self.current_batch
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
 
     def getdata(self):
         return self.current_batch.data

@@ -95,9 +95,19 @@ def _as_class_ids(label, pred):
     detected by SIZE, not exact shape: an (N,1)-vs-(N,) layout skew
     (DataIter column labels + id predictions) must not be mistaken for
     an (N,C) probability matrix — the old shape!=shape test sent (N,)
-    id predictions into argmax(axis=1) and crashed."""
-    pred_ids = (pred if pred.size == label.size
-                else pred.argmax(axis=1))
+    id predictions into argmax(axis=1) and crashed. Size-matched FLOAT
+    predictions that still look like probabilities (any value strictly
+    inside (0, 1) — a single-column sigmoid head) are thresholded at
+    0.5: the old straight int-cast truncated every such probability to
+    class 0 (ADVICE r5)."""
+    if pred.size == label.size:
+        pred_ids = pred
+        if pred_ids.dtype.kind == "f" and pred_ids.size:
+            frac = (pred_ids > 0.0) & (pred_ids < 1.0)
+            if frac.any():
+                pred_ids = (pred_ids >= 0.5)
+    else:
+        pred_ids = pred.argmax(axis=1)
     return label.astype("int64").ravel(), pred_ids.astype("int64").ravel()
 
 

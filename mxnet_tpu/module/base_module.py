@@ -9,6 +9,7 @@ param-file codec, instead of the reference's per-method inline loops.
 """
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import time
@@ -25,6 +26,11 @@ _H_STEP_SECONDS = _tm.histogram(
     "(forward_backward + update), labelled by epoch")
 _H_EPOCH_SECONDS = _tm.histogram(
     "fit.epoch_seconds", "Wall time of one training epoch")
+_G_DISPATCH_DEPTH = _tm.gauge(
+    "fit.dispatch_depth",
+    "Steps the fit loop's dispatch frontier is ahead of the deferred "
+    "metric drain (0 = synchronous per-batch metric fetch; bounded by "
+    "MXTPU_METRIC_INTERVAL)")
 
 
 def _as_list(obj):
@@ -171,6 +177,46 @@ class BaseModule(object):
         if validation_metric is None:
             validation_metric = eval_metric
 
+        # -- async dispatch pipeline (docs/performance.md) -------------
+        # Both knobs act on the fused mesh path only, defaults = parity:
+        # MXTPU_DEVICE_FEED (on) wraps train_data in a DeviceFeedIter so
+        # the next batch's host->device transfer is in flight during
+        # compute; MXTPU_METRIC_INTERVAL=k defers the blocking per-batch
+        # metric fetch k steps behind the dispatch frontier (same
+        # accumulation order — the final metric is bitwise-identical).
+        fit_data = train_data
+        _trainer = getattr(self, "_fused_trainer", None)
+        if (_trainer is not None
+                and not getattr(self, "_fused_multiproc", False)
+                and os.environ.get("MXTPU_DEVICE_FEED", "1") != "0"):
+            from ..io import DeviceFeedIter
+
+            fit_data = DeviceFeedIter(train_data, _trainer.batch_sharding())
+        try:
+            metric_iv = max(1, int(os.environ.get(
+                "MXTPU_METRIC_INTERVAL", "1")))
+        except ValueError:
+            metric_iv = 1
+        deferred_metrics = collections.deque()
+
+        def _queue_metric(data_batch):
+            snap = self._metric_snapshot() if metric_iv > 1 else None
+            if snap is None:
+                # cadence 1, or a path whose outputs can't be deferred
+                self.update_metric(eval_metric, data_batch.label)
+                return
+            deferred_metrics.append((data_batch.label, snap))
+            while len(deferred_metrics) >= metric_iv:
+                labels, s = deferred_metrics.popleft()
+                self._apply_metric_snapshot(eval_metric, labels, s)
+            _G_DISPATCH_DEPTH.set(len(deferred_metrics))
+
+        def _drain_metrics():
+            while deferred_metrics:
+                labels, s = deferred_metrics.popleft()
+                self._apply_metric_snapshot(eval_metric, labels, s)
+            _G_DISPATCH_DEPTH.set(0)
+
         # MXNET_FIT_MULTISTEP=K: group K batches into ONE XLA dispatch
         # (lax.scan over the fused step — Module.update_multi), amortizing
         # host dispatch overhead the way the reference's threaded engine
@@ -210,7 +256,7 @@ class BaseModule(object):
                             _H_STEP_SECONDS.observe(per, epoch=str(epoch))
                     for (nbatch, db), outs in zip(pending, steps):
                         self._install_step_outputs(outs)
-                        self.update_metric(eval_metric, db.label)
+                        _queue_metric(db)
                         _fire(batch_end_callback, epoch, nbatch,
                               eval_metric, _cb_locals(nbatch, db))
                 else:
@@ -224,11 +270,11 @@ class BaseModule(object):
                             self.update()
                             _H_STEP_SECONDS.observe(
                                 time.perf_counter() - t0, epoch=str(epoch))
-                        self.update_metric(eval_metric, db.label)
+                        _queue_metric(db)
                         _fire(batch_end_callback, epoch, nbatch,
                               eval_metric, _cb_locals(nbatch, db))
 
-            for nbatch, data_batch in enumerate(train_data):
+            for nbatch, data_batch in enumerate(fit_data):
                 use_multi = (
                     fit_k > 1 and monitor is None
                     and getattr(self, "_fused_trainer", None) is not None
@@ -258,7 +304,7 @@ class BaseModule(object):
                         time.perf_counter() - t0, epoch=str(epoch))
                 if _tm.enabled():
                     _tm.sample_device_memory()
-                self.update_metric(eval_metric, data_batch.label)
+                _queue_metric(data_batch)
                 if monitor is not None:
                     monitor.toc_print()
                 _fire(batch_end_callback, epoch, nbatch, eval_metric,
@@ -266,6 +312,7 @@ class BaseModule(object):
             if pending:
                 _flush_group(pending, epoch, eval_metric)
                 pending = []
+            _drain_metrics()  # deferred fetches land before epoch stats
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -292,7 +339,7 @@ class BaseModule(object):
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
 
-            train_data.reset()
+            fit_data.reset()  # resets train_data through the feed wrapper
 
     # ------------------------------------------------------------------
     # symbol / params
@@ -369,6 +416,17 @@ class BaseModule(object):
         raise NotImplementedError()
 
     def update_metric(self, eval_metric, labels):
+        raise NotImplementedError()
+
+    def _metric_snapshot(self):
+        """Deferred-metric hook for fit()'s MXTPU_METRIC_INTERVAL path:
+        return per-step output state that stays valid k steps later
+        (Module's fused path returns its raw jax outputs), or None to
+        force the immediate update_metric path."""
+        return None
+
+    def _apply_metric_snapshot(self, eval_metric, labels, snapshot):
+        """Accumulate one deferred step captured by _metric_snapshot."""
         raise NotImplementedError()
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,

@@ -17,8 +17,15 @@ import numpy as np
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from ..base import MXNetError
+from .. import telemetry as _tm
 from ..executor import Executor
 from ..io import DataDesc
+
+_M_LOAD_FASTPATH = _tm.counter(
+    "executor_group.load_fastpath",
+    "Whole-batch input loads served by aliasing the (immutable) source "
+    "buffer instead of slice + copyto (single target slice, matching "
+    "shape/dtype/sharding)")
 
 
 def _split_input_slice(batch_size, work_load_list):
@@ -46,9 +53,29 @@ def _load_general(data, targets):
     for d_src, d_targets in zip(data, targets):
         if isinstance(d_targets, nd.NDArray):
             d_src.copyto(d_targets)
-        else:
-            for slice_idx, d_dst in d_targets:
-                d_src[slice_idx].copyto(d_dst)
+            continue
+        if len(d_targets) == 1:
+            # single-device fast path: when the one target slice covers
+            # the whole batch and src/dst agree on shape, dtype, and
+            # placement, adopt the source's (immutable) buffer — this
+            # replaces the per-step slice + host round-trip copy, and a
+            # DeviceFeedIter-staged batch needs no transfer at all
+            slice_idx, d_dst = d_targets[0]
+            src = getattr(d_src, "_data", None)
+            dst = getattr(d_dst, "_data", None)
+            if (src is not None and dst is not None
+                    and getattr(d_src, "_engine_dep", None) is None
+                    and getattr(d_dst, "_engine_dep", None) is None
+                    and (slice_idx.stop - slice_idx.start) == d_src.shape[0]
+                    and tuple(d_dst.shape) == tuple(d_src.shape)
+                    and dst.dtype == src.dtype
+                    and getattr(src, "sharding", None)
+                    == getattr(dst, "sharding", None)):
+                _M_LOAD_FASTPATH.inc()
+                d_dst._data = src
+                continue
+        for slice_idx, d_dst in d_targets:
+            d_src[slice_idx].copyto(d_dst)
 
 
 def _load_data(batch, targets):

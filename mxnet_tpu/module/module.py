@@ -8,6 +8,7 @@ _update_params_on_kvstore / _update_params (module.py:553).
 from __future__ import annotations
 
 import logging
+import time
 
 import numpy as np
 
@@ -23,6 +24,27 @@ from ..model import (
 )
 from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
+
+_H_DISPATCH_HOST = _tm.histogram(
+    "module.dispatch_host_seconds",
+    "Host wall time to stage inputs + enqueue one fused train step "
+    "(the dispatch returns before the device finishes, so this is the "
+    "pure per-step host overhead — executor.step_seconds' host "
+    "component; multi-step dispatches record the amortized per-step "
+    "cost)")
+_H_STAGE_HOST = _tm.histogram(
+    "module.stage_host_seconds",
+    "Host wall time of the input-STAGING slice of a fused step "
+    "(asnumpy + device_put, or the DeviceFeedIter adoption check) — "
+    "the component the device-resident feed removes. Kept separate "
+    "from dispatch_host_seconds because on the CPU backend the enqueue "
+    "itself blocks on donated in-flight buffers (a jax CPU-client "
+    "artifact the TPU runtime does not have)")
+_M_FEED_HITS = _tm.counter(
+    "module.feed_fastpath_hits",
+    "Fused-step input arrays adopted directly from a DeviceFeedIter "
+    "staging (sharding matched: no asnumpy sync, no per-step "
+    "device_put)")
 
 
 def _local_rows(arr):
@@ -489,6 +511,13 @@ class Module(BaseModule):
                 # shard; global batch = local batch x num_workers)
                 return jax.make_array_from_process_local_data(
                     sharding, arr.asnumpy())
+            data = getattr(arr, "_data", None)
+            if data is not None and getattr(data, "sharding", None) == sharding:
+                # DeviceFeedIter staged this batch on the mesh already —
+                # hand the (immutable) buffer straight to the compiled
+                # step: no asnumpy sync, no per-step host->device copy
+                _M_FEED_HITS.inc()
+                return data
             return jax.device_put(arr.asnumpy(), sharding)
 
         batch = {}
@@ -568,7 +597,6 @@ class Module(BaseModule):
         if self._fused_trainer is not None:
             assert self._fused_batch is not None, "forward() before update()"
             owner = self._fused_owner
-            batch = self._make_fused_batch(self._fused_batch)
             optm = self._optimizer
             owner._fused_t += 1
             optm.num_update = max(owner._fused_t, optm.num_update)
@@ -587,10 +615,16 @@ class Module(BaseModule):
                 self._fused_aux = owner._fused_aux
                 self._fused_opt = owner._fused_opt
             with _tm.span("module.update", path="fused"):
+                # staging + enqueue together are the step's host-side
+                # cost: the trainer call returns before the device runs
+                t0 = time.perf_counter()
+                batch = self._make_fused_batch(self._fused_batch)
+                _H_STAGE_HOST.observe(time.perf_counter() - t0)
                 p, a, s, outs = self._fused_trainer(
                     owner._fused_params, owner._fused_aux, owner._fused_opt,
                     batch, lr=lr, t=owner._fused_t,
                 )
+                _H_DISPATCH_HOST.observe(time.perf_counter() - t0)
             owner._fused_params, owner._fused_aux, owner._fused_opt = p, a, s
             # raw jax.Arrays; _local_rows conversion (a host transfer in
             # multi-process runs) happens lazily on first read so loops
@@ -639,22 +673,38 @@ class Module(BaseModule):
         k = len(data_batches)
         if (self._kvstore is not None
                 and getattr(self._kvstore, "_heartbeat", None) is not None):
-            self._kvstore._heartbeat.progress()
+            # one dispatch = K optimizer steps: credit all K ticks so a
+            # progress watchdog tuned to per-batch cadence doesn't
+            # false-trip mid-dispatch (ADVICE r5)
+            self._kvstore._heartbeat.progress(ticks=k)
         self._params_dirty = True
 
+        t0_host = time.perf_counter()
         sharding = trainer.batch_sharding_stacked()
+        per_batch_sharding = trainer.batch_sharding()
         multiproc = getattr(self, "_fused_multiproc", False) or getattr(
             owner, "_fused_multiproc", False)
 
         def _put_stack(arrs):
-            stacked = np.stack([a.asnumpy() for a in arrs])
-            if multiproc:
-                import jax
-
-                return jax.make_array_from_process_local_data(
-                    sharding, stacked)
             import jax
 
+            if not multiproc:
+                datas = [getattr(a, "_data", None) for a in arrs]
+                if all(d is not None
+                       and getattr(d, "sharding", None) == per_batch_sharding
+                       for d in datas):
+                    # DeviceFeedIter already staged every micro-batch on
+                    # the mesh: stack device-side instead of bouncing K
+                    # batches through the host (composes the K-step scan
+                    # path with the double-buffered feed)
+                    import jax.numpy as jnp
+
+                    _M_FEED_HITS.inc(len(arrs))
+                    return jax.device_put(jnp.stack(datas), sharding)
+            stacked = np.stack([a.asnumpy() for a in arrs])
+            if multiproc:
+                return jax.make_array_from_process_local_data(
+                    sharding, stacked)
             return jax.device_put(stacked, sharding)
 
         batches = {}
@@ -664,6 +714,10 @@ class Module(BaseModule):
             for i, name in enumerate(self._label_names):
                 batches[name] = _put_stack(
                     [b.label[i] for b in data_batches])
+        if _tm.enabled():
+            per_stage = (time.perf_counter() - t0_host) / k
+            for _ in range(k):
+                _H_STAGE_HOST.observe(per_stage)
 
         # advance the schedule exactly as K update() calls would
         lrs, ts = [], []
@@ -681,6 +735,12 @@ class Module(BaseModule):
         p, a, s, outs = trainer.call_multi(
             owner._fused_params, owner._fused_aux, owner._fused_opt,
             batches, lrs, ts)
+        if _tm.enabled():
+            # amortized per-step host cost, recorded once per micro-step
+            # so the histogram stays comparable with update()'s samples
+            per = (time.perf_counter() - t0_host) / k
+            for _ in range(k):
+                _H_DISPATCH_HOST.observe(per)
         owner._fused_params, owner._fused_aux, owner._fused_opt = p, a, s
         owner._fused_exec_stale = True
         self._fused_exec_stale = True
@@ -732,6 +792,25 @@ class Module(BaseModule):
                 eval_metric.update(labels, outs)
                 return
         self._exec_group.update_metric(eval_metric, labels)
+
+    def _metric_snapshot(self):
+        """Deferred-metric hook (BaseModule.fit, MXTPU_METRIC_INTERVAL):
+        the fused path's raw per-step outputs are freshly allocated jax
+        arrays, so holding references keeps them valid while later steps
+        dispatch. Returns None on the executor path — its output
+        NDArrays are REUSED across steps, so a deferred read would see
+        a later step's values."""
+        if (self._fused_trainer is not None
+                and self._fused_outs_raw is not None):
+            return list(self._fused_outs_raw)
+        return None
+
+    def _apply_metric_snapshot(self, eval_metric, labels, snapshot):
+        """Drain one deferred step: the blocking host transfer happens
+        HERE, k steps behind the dispatch frontier; accumulation math
+        and order match an immediate update_metric exactly."""
+        eval_metric.update(
+            labels, [nd.NDArray(_local_rows(o)) for o in snapshot])
 
     def _sync_params_from_devices(self):
         """Parity module.py:666."""

@@ -91,6 +91,7 @@ class HeartbeatWriter:
         self._stop = threading.Event()
         self._thread = None
         self._last_prog = 0.0
+        self._last_ticks = 0
         os.makedirs(directory, exist_ok=True)
 
     def start(self):
@@ -110,16 +111,31 @@ class HeartbeatWriter:
             self._thread.join(timeout=self._interval + 1.0)
             self._thread = None
 
-    def progress(self):
+    def progress(self, ticks=1):
         """Mark forward progress from the worker's OWN thread (kvstore
-        push/pull/barrier). Rate-limited to one touch per interval so
-        per-key push loops don't turn into an utime storm."""
+        push/pull/barrier; fused update). Rate-limited to one touch per
+        interval so per-key push loops don't turn into an utime storm.
+
+        ``ticks`` > 1 reports a multi-batch dispatch (Module.update_multi
+        runs K optimizer steps per host call, so the next report is K
+        batch-times away). The K-1 extra ticks bank FUTURE mtime credit
+        — estimated from the previous inter-report gap — so
+        ``tools/watchdog.py --progress-timeout`` tuned to per-batch
+        cadence doesn't false-trip mid-dispatch (ADVICE r5)."""
         now = time.monotonic()
-        if now - self._last_prog < self._interval:
+        if ticks <= 1 and now - self._last_prog < self._interval:
             return
+        per_tick = 0.0
+        if self._last_prog > 0.0 and self._last_ticks > 0:
+            per_tick = max(0.0, now - self._last_prog) / self._last_ticks
         self._last_prog = now
+        self._last_ticks = ticks
         try:
             _touch(self._prog_path)
+            credit = (ticks - 1) * per_tick
+            if credit > 0.0:
+                t = time.time() + credit
+                os.utime(self._prog_path, (t, t))
         except OSError:
             pass  # progress is advisory; liveness beat handles teardown
 

@@ -13,6 +13,7 @@ key sharding. Mesh axes follow the scaling-book convention:
 """
 from __future__ import annotations
 
+import logging
 import os
 import time as _time_mod
 
@@ -24,6 +25,31 @@ _H_COLLECTIVE_SECONDS = _tm.histogram(
     "parallel.collective_seconds",
     "Host-observed latency of explicit cross-process collectives "
     "(labelled by op: barrier / allreduce_sum / broadcast)")
+
+_INJECT_WARNED = False
+
+
+def _injected_latency_ms():
+    """MXNET_KVSTORE_INJECT_LATENCY_MS (bench/test knob), parsed to a
+    float or 0. Warns ONCE per process when active: a forgotten export
+    injects sleep into EVERY cross-process allreduce and is
+    indistinguishable from a slow interconnect in the telemetry
+    (ADVICE r5)."""
+    global _INJECT_WARNED
+    raw = os.environ.get("MXNET_KVSTORE_INJECT_LATENCY_MS")
+    if not raw:
+        return 0.0
+    try:
+        ms = float(raw)
+    except ValueError:
+        return 0.0
+    if ms > 0.0 and not _INJECT_WARNED:
+        _INJECT_WARNED = True
+        logging.getLogger(__name__).warning(
+            "MXNET_KVSTORE_INJECT_LATENCY_MS=%s: injecting %.1f ms of "
+            "artificial latency into every cross-process allreduce "
+            "(bench/test knob — unset it for real runs)", raw, ms)
+    return ms
 
 
 def device_count():
@@ -117,11 +143,9 @@ def allreduce_sum(value):
     # is the bottleneck — on the 1-core CI box localhost gloo has ~zero
     # latency, so without this the collective chain can never be hidden).
     # The sleep releases the GIL like a real network wait would.
-    inj_ms = os.environ.get("MXNET_KVSTORE_INJECT_LATENCY_MS")
+    inj_ms = _injected_latency_ms()  # warns once when the knob is live
     if inj_ms:
-        import time as _time
-
-        _time.sleep(float(inj_ms) / 1000.0)
+        _time_mod.sleep(inj_ms / 1000.0)
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

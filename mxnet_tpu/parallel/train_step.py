@@ -110,7 +110,6 @@ class ShardedTrainStep:
         self._batch_spec = P("dp")
         self._step = None
         self._step_multi = {}  # K -> jitted K-step scan program
-        self._creation_shapes_sig = None
         self._needs_rng = any(
             (not n.is_variable) and n.op.needs_rng
             for n in self.program.nodes
@@ -381,17 +380,24 @@ class ShardedTrainStep:
 
         k = len(lrs)
         fn = self.compile_multi(k)
+        # dispatch fast path (_GraphProgram.dispatch_plan): key on the
+        # batch entries alone — param shapes are fixed per trainer, and
         # creation-shape overrides depend only on the PER-STEP shapes
-        # (scan axis dropped), so the signature is shared with __call__
-        shapes = {n: tuple(v.shape) for n, v in params.items()}
-        shapes.update({n: tuple(v.shape[1:]) for n, v in batches.items()})
-        sig = tuple(sorted(shapes.items()))
-        if sig != self._creation_shapes_sig:
+        # (scan axis dropped)
+        sig = tuple(
+            (n, tuple(v.shape[1:]), str(v.dtype),
+             getattr(v, "sharding", None))
+            for n, v in batches.items())
+
+        def _build():
             from ..executor import resolve_creation_shapes
 
-            self.program.shape_overrides = resolve_creation_shapes(
-                self.symbol, shapes)
-            self._creation_shapes_sig = sig
+            shapes = {n: tuple(v.shape) for n, v in params.items()}
+            shapes.update(
+                {n: tuple(v.shape[1:]) for n, v in batches.items()})
+            return resolve_creation_shapes(self.symbol, shapes)
+
+        self.program.dispatch_plan(sig, _build)
         if self._needs_rng:
             from .. import random as _random
 
@@ -409,19 +415,26 @@ class ShardedTrainStep:
         import jax.numpy as jnp
 
         # resolve 0-dims in creation-op shape attrs (rnn begin_state zeros
-        # etc.) against the CURRENT input shapes, before jit traces: keyed
-        # by shape signature so a batch-size change (Module.reshape,
-        # partial final batch) re-resolves instead of retracing against
-        # stale overrides. Already-traced signatures stay cached in jit.
-        shapes = {n: tuple(v.shape) for n, v in params.items()}
-        shapes.update({n: tuple(v.shape) for n, v in batch.items()})
-        sig = tuple(sorted(shapes.items()))
-        if sig != self._creation_shapes_sig:
+        # etc.) against the CURRENT input shapes, before jit traces. The
+        # dispatch plan is keyed on the batch entries' (shape, dtype,
+        # sharding) alone — param shapes are fixed per trainer — so the
+        # steady state iterates 1-4 batch items instead of rebuilding and
+        # sorting the full params+batch shape dict every step; a
+        # batch-size change (Module.reshape, partial final batch) or a
+        # re-placed input re-resolves once. Already-traced signatures
+        # stay cached in jit.
+        sig = tuple(
+            (n, tuple(v.shape), str(v.dtype), getattr(v, "sharding", None))
+            for n, v in batch.items())
+
+        def _build():
             from ..executor import resolve_creation_shapes
 
-            self.program.shape_overrides = resolve_creation_shapes(
-                self.symbol, shapes)
-            self._creation_shapes_sig = sig
+            shapes = {n: tuple(v.shape) for n, v in params.items()}
+            shapes.update({n: tuple(v.shape) for n, v in batch.items()})
+            return resolve_creation_shapes(self.symbol, shapes)
+
+        self.program.dispatch_plan(sig, _build)
 
         if lr is None:
             opt = self.optimizer

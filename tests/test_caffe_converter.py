@@ -248,6 +248,22 @@ def test_repeated_per_axis_params():
     assert cc._xy(p2, "kernel_size", "kernel_h", "kernel_w", None) == (5, 4)
 
 
+def test_one_sided_hw_params():
+    """A lone pad_h / kernel_w etc. is legal caffe and must not
+    KeyError: the absent side falls back to the repeated single value,
+    then the default, then mirrors the present side (ADVICE r5)."""
+    assert cc._xy({"pad_h": ["2"]}, "pad", "pad_h", "pad_w",
+                  (0, 0)) == (2, 0)
+    assert cc._xy({"stride_w": ["3"]}, "stride", "stride_h", "stride_w",
+                  (1, 1)) == (1, 3)
+    # the single-value entry supplies the missing side before the default
+    assert cc._xy({"kernel_size": ["7"], "kernel_h": ["5"]},
+                  "kernel_size", "kernel_h", "kernel_w", None) == (5, 7)
+    # no single value, no default (kernel): mirror the present side
+    assert cc._xy({"kernel_w": ["3"]}, "kernel_size", "kernel_h",
+                  "kernel_w", None) == (3, 3)
+
+
 def test_scale_pairs_by_topology_not_file_order(tmp_path):
     """Two BNs then one Scale consuming the FIRST BN's top: the folded
     gamma/beta must land on bn_a (topology), not bn_b (file order)."""

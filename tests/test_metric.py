@@ -23,6 +23,22 @@ def test_accuracy_probs_and_ids():
     assert m.get() == ("accuracy", 0.5)
 
 
+def test_accuracy_sigmoid_probabilities_threshold():
+    """Size-matched FLOAT predictions carrying probabilities (a
+    single-column sigmoid head) threshold at 0.5 — the old int-cast
+    truncated 0.9 to class 0 (ADVICE r5). Hard float ids (0.0/1.0/2.0)
+    must still pass through un-thresholded."""
+    m = mx.metric.Accuracy()
+    m.update([_nd([1, 0, 1, 0])], [_nd([[0.9], [0.2], [0.4], [0.6]])])
+    assert m.get() == ("accuracy", 0.5)  # hits: 0.9->1, 0.2->0
+    m.reset()
+    m.update([_nd([1, 0, 1])], [_nd([0.7, 0.3, 0.51])])  # (N,) layout
+    assert m.get() == ("accuracy", 1.0)
+    m.reset()
+    m.update([_nd([0, 1, 2])], [_nd([0.0, 1.0, 2.0])])  # hard float ids
+    assert m.get() == ("accuracy", 1.0)
+
+
 def test_top_k_accuracy():
     m = mx.metric.TopKAccuracy(top_k=2)
     assert m.name == "top_k_accuracy_2"

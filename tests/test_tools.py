@@ -123,7 +123,10 @@ def test_launch_tracker_modes_dry_run(tmp_path, capsys, monkeypatch):
     contract and exec the command."""
     import launch
 
-    # sge/yarn write the shim into cwd (shared-filesystem contract)
+    # mpi/sge/yarn all write the shim into cwd: remote tasks see the
+    # submit dir via the shared filesystem, never this node's /tmp
+    # (ADVICE r5 — the mpi shim used to land in /tmp and broke
+    # multi-node runs with file-not-found)
     monkeypatch.chdir(tmp_path)
 
     for mode, fn, kw in (
@@ -136,6 +139,7 @@ def test_launch_tracker_modes_dry_run(tmp_path, capsys, monkeypatch):
         shim = next(tok for tok in out.split()
                     if "mxtpu_launch_" in tok).rstrip("'\"")
         shim = shim.split("=")[-1]
+        assert os.path.dirname(os.path.abspath(shim)) == str(tmp_path), mode
         body = open(shim).read()
         assert "JAX_NUM_PROCESSES=\"3\"" in body, mode
         assert "DMLC_NUM_WORKER=\"3\"" in body, mode

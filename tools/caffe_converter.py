@@ -270,10 +270,24 @@ def convert_mean(binaryproto_fname, output_fname=None, mx=None):
 def _xy(d, single, h, w, default):
     """caffe's single-value / repeated-(h,w) / explicit h+w convention
     -> (y, x) tuple. `repeated uint32 kernel_size: 3 kernel_size: 2`
-    means (h=3, w=2); a single entry means square."""
+    means (h=3, w=2); a single entry means square. A lone pad_h /
+    kernel_w etc. is legal caffe (each axis falls back independently):
+    the absent side comes from the single-value entry, then the
+    default, then the present side — the old d[h]/d[w] double lookup
+    raised KeyError (ADVICE r5)."""
     vals = d.get(single, [])
-    if d.get(h) or d.get(w):
-        return (int(d[h][-1]), int(d[w][-1]))
+    hv, wv = d.get(h), d.get(w)
+    if hv or wv:
+        def _side(present, idx):
+            if present:
+                return int(present[-1])
+            if vals:
+                return int(vals[idx] if len(vals) > idx else vals[0])
+            if default is not None:
+                return int(default[idx])
+            return int((hv or wv)[-1])
+
+        return (_side(hv, 0), _side(wv, 1))
     if vals:
         if len(vals) >= 2:
             return (int(vals[0]), int(vals[1]))

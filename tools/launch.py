@@ -106,13 +106,18 @@ def launch_local(num_workers, command, coordinator_port=29500):
         _cleanup_run_dir()
         sys.exit(1)
 
-    signal.signal(signal.SIGINT, _kill)
-    signal.signal(signal.SIGTERM, _kill)
+    # restore the caller's handlers on exit: launch_local is also called
+    # in-process (tests, notebooks), where a leaked _kill would turn a
+    # later unrelated SIGTERM into sys.exit(1)
+    prev_int = signal.signal(signal.SIGINT, _kill)
+    prev_term = signal.signal(signal.SIGTERM, _kill)
     rc = 0
     try:
         for p in procs:
             rc |= p.wait()
     finally:
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
         _cleanup_run_dir()
     return rc
 
@@ -168,9 +173,10 @@ def _write_rank_shim(num_workers, coordinator, command, shared=False):
     no PS tier every task is a worker and rank is all it needs.
 
     shared=True writes into the job's cwd instead of node-local /tmp:
-    sge/yarn tasks execute on OTHER hosts, which see the submit dir via
-    the cluster's shared filesystem (the same assumption qsub -cwd and
-    the reference's dmlc tracker logs make) but never this node's /tmp."""
+    mpi/sge/yarn tasks may execute on OTHER hosts, which see the submit
+    dir via the cluster's shared filesystem (the same assumption qsub
+    -cwd, mpirun with a hostfile, and the reference's dmlc tracker logs
+    make) but never this node's /tmp."""
     import shlex
 
     if shared:
@@ -203,7 +209,11 @@ def launch_mpi(num_workers, command, coordinator_port=29500,
     """Reference dmlc mpi tracker analog: one mpirun over N ranks."""
     coordinator = "%s:%d" % (os.environ.get("MXTPU_COORD_HOST",
                                             "127.0.0.1"), coordinator_port)
-    shim = _write_rank_shim(num_workers, coordinator, command)
+    # shared=True: mpirun -hostfile launches ranks on other nodes, which
+    # reach the submit dir over the shared filesystem but not this
+    # node's /tmp (ADVICE r5 — a /tmp shim broke multi-node MPI with
+    # file-not-found)
+    shim = _write_rank_shim(num_workers, coordinator, command, shared=True)
     tool = ("mpirun" if shutil.which("mpirun") else
             "mpiexec" if shutil.which("mpiexec") else "mpirun")
     return _submit([tool, "-np", str(num_workers), shim], tool, dry_run)

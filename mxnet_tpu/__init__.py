@@ -54,6 +54,7 @@ from . import rnn
 from . import image
 from . import profiler
 from . import telemetry
+from . import resilience
 from . import visualization
 from . import visualization as viz
 from . import model

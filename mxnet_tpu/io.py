@@ -72,6 +72,17 @@ class DataIter(object):
     def iter_next(self):
         pass
 
+    def skip(self, num_batches):
+        """Advance past ``num_batches`` batches without using them —
+        checkpoint resume repositions a freshly reset iterator this way.
+        The generic fallback simply consumes batches; iterators with a
+        cheap cursor (NDArrayIter, DeviceFeedIter) override it."""
+        for _ in range(int(num_batches)):
+            try:
+                self.next()
+            except StopIteration:
+                return
+
     def getdata(self):
         pass
 
@@ -372,6 +383,19 @@ class DeviceFeedIter(DataIter):
         self.iter.reset()
         self._fill()
 
+    def skip(self, num_batches):
+        """Resume repositioning: drop already-staged transfers first
+        (their references die; jax arrays are immutable so mid-flight
+        abandonment is safe), push the remainder down to the inner
+        iterator's (possibly O(1)) skip, then restage."""
+        num_batches = int(num_batches)
+        while num_batches > 0 and self._staged:
+            self._staged.popleft()
+            num_batches -= 1
+        if num_batches > 0:
+            self.iter.skip(num_batches)
+        self._fill()
+
     def next(self):
         t0 = time.perf_counter()
         if not self._staged:
@@ -475,6 +499,10 @@ class NDArrayIter(DataIter):
     def iter_next(self):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
+
+    def skip(self, num_batches):
+        # cursor math, no data touched: resume repositioning is O(1)
+        self.cursor += int(num_batches) * self.batch_size
 
     def next(self):
         if self.iter_next():

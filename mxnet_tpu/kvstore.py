@@ -52,6 +52,8 @@ from . import optimizer as opt
 from . import telemetry as _tm
 from .base import MXNetError
 from .ndarray import NDArray
+from .resilience import fault as _fault
+from .resilience import retry as _retry
 
 _M_PUSH_BYTES = _tm.counter(
     "kvstore.push_bytes", "Bytes pushed into the kvstore")
@@ -195,7 +197,18 @@ class KVStore(object):
             if not self._is_dist:
                 def _do_push(snap=snap, k=k, upd_key=upd_key):
                     t0 = time.perf_counter()
-                    _apply(self._reduce(snap), k, upd_key)
+
+                    def _reduce_body():
+                        _fault.fire("kv_push", key=k)
+                        return self._reduce(snap)
+
+                    # Retry covers the reduce only — it reads immutable
+                    # snapshots, so a re-run is exact. The updater is
+                    # applied once, after a successful reduce (retrying
+                    # through a half-applied update would double-step
+                    # momentum).
+                    merged = _retry.call(_reduce_body, name="kv.push")
+                    _apply(merged, k, upd_key)
                     _H_PUSH_SECONDS.observe(time.perf_counter() - t0)
 
                 self._comm.push(_do_push, mutable_vars=[self._key_var(k)],
@@ -215,10 +228,17 @@ class KVStore(object):
             # key k's cross-process allreduce.
             box = {}
 
-            def _local_reduce(snap=snap, box=box):
+            def _local_reduce(snap=snap, box=box, k=k):
                 try:
                     t0 = time.perf_counter()
-                    merged = self._reduce(snap)
+
+                    def _reduce_body():
+                        _fault.fire("kv_push", key=k)
+                        return self._reduce(snap)
+
+                    # Retryable: purely local, reads immutable snapshots.
+                    # Stage 2's collective is NOT retried — see below.
+                    merged = _retry.call(_reduce_body, name="kv.push")
                     _H_PUSH_SECONDS.observe(time.perf_counter() - t0)
                     box["host"] = merged.asnumpy()
                     box["ctx"] = merged.context
@@ -234,6 +254,12 @@ class KVStore(object):
 
             def _allreduce_apply(box=box, k=k, upd_key=upd_key,
                                  snap0=snap[0]):
+                # Deliberately NO retry around this stage: every rank
+                # issues collectives in lockstep on the chain var, and a
+                # rank re-entering an allreduce its peers already left
+                # deadlocks the mesh. Collective failure is process-fatal
+                # by design — recovery is watchdog restart + checkpoint
+                # resume (resilience/checkpoint.py).
                 from .parallel import mesh as _mesh
 
                 if "error" in box:
@@ -275,15 +301,21 @@ class KVStore(object):
             def _do_pull(k=k, outs=outs):
                 import jax
 
-                t0 = time.perf_counter()
-                stored = self._store[k]
-                for o in outs:
-                    # direct _data write, NOT copyto: copyto drains the
-                    # target's engine var, which is held by THIS op —
-                    # calling it here would self-deadlock
-                    o._data = jax.device_put(stored._data,
-                                             o._data.device)
-                _H_PULL_SECONDS.observe(time.perf_counter() - t0)
+                def _body():
+                    t0 = time.perf_counter()
+                    _fault.fire("kv_pull", key=k)
+                    stored = self._store[k]
+                    for o in outs:
+                        # direct _data write, NOT copyto: copyto drains
+                        # the target's engine var, which is held by THIS
+                        # op — calling it here would self-deadlock
+                        o._data = jax.device_put(stored._data,
+                                                 o._data.device)
+                    _H_PULL_SECONDS.observe(time.perf_counter() - t0)
+
+                # device_put is idempotent (pure read of the stored
+                # value, rebind of the out handle), so pulls retry whole.
+                _retry.call(_body, name="kv.pull")
 
             out_vars = []
             seen = set()
@@ -374,8 +406,10 @@ class KVStore(object):
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
+        from .resilience.checkpoint import atomic_file
+
         self._comm.wait_for_all()  # states must include in-flight updates
-        with open(fname, "wb") as fout:
+        with atomic_file(fname) as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

@@ -95,13 +95,21 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """prefix-symbol.json + prefix-%04d.params (parity model.py:319)."""
+    """prefix-symbol.json + prefix-%04d.params (parity model.py:319).
+
+    Both files go through the atomic writer (temp + fsync + rename): a
+    preemption mid-write can no longer leave a truncated .params that
+    tools/watchdog.py's find_latest_checkpoint would resume from."""
+    from .resilience.checkpoint import atomic_file
+
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        with atomic_file("%s-symbol.json" % prefix, mode="w") as f:
+            f.write(symbol.tojson())
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    with atomic_file(param_name) as f:
+        nd._save_fileobj(f, save_dict)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

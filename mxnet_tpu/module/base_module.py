@@ -12,10 +12,15 @@ from __future__ import annotations
 import collections
 import logging
 import os
+import pickle
+import signal
 import time
+
+import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import random as _rnd
 from .. import telemetry as _tm
 from ..initializer import Uniform
 from ..model import BatchEndParam
@@ -31,6 +36,15 @@ _G_DISPATCH_DEPTH = _tm.gauge(
     "Steps the fit loop's dispatch frontier is ahead of the deferred "
     "metric drain (0 = synchronous per-batch metric fetch; bounded by "
     "MXTPU_METRIC_INTERVAL)")
+_C_RESUME_LOADED = _tm.counter(
+    "resume.loaded", "fit() calls that restored state from a checkpoint")
+_C_RESUME_NONE = _tm.counter(
+    "resume.none_found",
+    "fit() resume requests that found no valid checkpoint")
+_C_PREEMPTED = _tm.counter(
+    "fit.preempted",
+    "fit() loops that exited through the SIGTERM/SIGINT grace path "
+    "after writing a final checkpoint")
 
 
 def _as_list(obj):
@@ -154,8 +168,19 @@ class BaseModule(object):
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """THE training loop — parity base_module.py:368-516 (§3.1)."""
+            monitor=None, checkpoint_dir=None, resume=None):
+        """THE training loop — parity base_module.py:368-516 (§3.1).
+
+        Preemption-safe extension (docs/robustness.md): ``checkpoint_dir``
+        (a path or a ``resilience.CheckpointManager``) turns on atomic
+        full-state checkpointing — at every epoch end, every
+        ``MXTPU_CKPT_INTERVAL`` optimizer steps, and on SIGTERM/SIGINT
+        (drain in-flight dispatch, write a final checkpoint, exit with
+        ``resilience.EXIT_PREEMPTED``). ``resume="auto"`` (or an explicit
+        step number) restores params, optimizer state, RNG streams,
+        metric accumulation, and the data-iterator position from the
+        newest checkpoint whose manifest verifies — continuation is
+        bitwise-identical to a run that was never interrupted."""
         assert num_epoch is not None, "please specify number of epochs"
         self.bind(
             data_shapes=train_data.provide_data,
@@ -227,9 +252,164 @@ class BaseModule(object):
         except ValueError:
             fit_k = 1
 
+        # -- preemption-safe checkpointing (resilience/) ---------------
+        from ..resilience import checkpoint as _ckpt
+        from ..resilience import fault as _fault
+
+        ckpt_mgr = None
+        if checkpoint_dir is not None:
+            ckpt_mgr = (checkpoint_dir
+                        if isinstance(checkpoint_dir, _ckpt.CheckpointManager)
+                        else _ckpt.CheckpointManager(checkpoint_dir))
+        elif resume is not None:
+            raise ValueError("fit(resume=...) requires checkpoint_dir")
+        try:
+            ckpt_interval = max(0, int(os.environ.get(
+                _ckpt.ENV_INTERVAL, "0")))
+        except ValueError:
+            ckpt_interval = 0
+
+        resume_skip = 0
+        resume_metric = None
+        gs0 = 0
+        if ckpt_mgr is not None and resume is not None:
+            if resume == "auto":
+                state = ckpt_mgr.load()
+            elif isinstance(resume, int) and not isinstance(resume, bool):
+                state = ckpt_mgr.load(step=resume)
+            else:
+                raise ValueError(
+                    'resume must be "auto" or a checkpoint step, got %r'
+                    % (resume,))
+            if state is None:
+                _C_RESUME_NONE.inc()
+                self.logger.info(
+                    "resume: no valid checkpoint under %s — starting fresh",
+                    ckpt_mgr.directory)
+            else:
+                self._restore_train_state(state["module"])
+                rng = state.get("rng") or {}
+                if rng.get("numpy") is not None:
+                    np.random.set_state(rng["numpy"])
+                if rng.get("mx") is not None:
+                    _rnd.set_state(rng["mx"])
+                begin_epoch = int(state.get("epoch", begin_epoch))
+                resume_skip = int(state.get("nbatch", 0))
+                gs0 = int(state.get("global_step", 0))
+                resume_metric = state.get("metric")
+                ckpt_mgr.last_step = gs0
+                _C_RESUME_LOADED.inc()
+                self.logger.info(
+                    "resume: restored step %d (epoch %d, batch %d)",
+                    gs0, begin_epoch, resume_skip)
+
+        loop = {"gs": gs0, "done": resume_skip, "epoch": begin_epoch,
+                "last_saved": gs0}
+        preempt = {"flag": False}
+
+        def _capture(epoch_next, nbatch_done):
+            try:
+                metric_blob = pickle.dumps(eval_metric, protocol=2)
+            except Exception:  # unpicklable custom metric (e.g. lambda
+                metric_blob = None  # feval): resume restarts its epoch
+            return {
+                "module": self._capture_train_state(),
+                "epoch": int(epoch_next),
+                "nbatch": int(nbatch_done),
+                "global_step": int(loop["gs"]),
+                "metric": metric_blob,
+                "rng": {"numpy": np.random.get_state(),
+                        "mx": _rnd.get_state()},
+            }
+
+        def _after_steps(epoch, done, n_new):
+            """Bookkeeping after ``n_new`` batches finished training
+            (``done`` = batches of this epoch now fully trained). Fires
+            the fault harness per optimizer step, honors a pending
+            preemption, and takes interval snapshots — always on a group
+            boundary, so the captured params exactly match the recorded
+            iterator position."""
+            if _fault.configured():
+                for s in range(loop["gs"] + 1, loop["gs"] + n_new + 1):
+                    _fault.fire("step", step=s)
+            loop["gs"] += n_new
+            loop["done"] = done
+            loop["epoch"] = epoch
+            if ckpt_mgr is None:
+                return
+            if preempt["flag"]:
+                # grace path: dispatch frontier already behind us (the
+                # group completed), deferred metric fetches drain, and
+                # the final checkpoint is written synchronously
+                _drain_metrics()
+                ckpt_mgr.save(_capture(epoch, done), loop["gs"])
+                _C_PREEMPTED.inc()
+                self.logger.info(
+                    "preempted: checkpoint at step %d written, exiting %d",
+                    loop["gs"], _ckpt.EXIT_PREEMPTED)
+                raise SystemExit(_ckpt.EXIT_PREEMPTED)
+            if (ckpt_interval
+                    and loop["gs"] - loop["last_saved"] >= ckpt_interval):
+                loop["last_saved"] = loop["gs"]
+                _drain_metrics()
+                ckpt_mgr.save_async(_capture(epoch, done), loop["gs"])
+
+        old_handlers = {}
+        if ckpt_mgr is not None:
+            def _on_preempt(signum, frame):
+                # flag only — the loop checkpoints at the next group
+                # boundary, where captured state and iterator position
+                # agree (checkpointing from the handler could tear a
+                # multi-step dispatch)
+                preempt["flag"] = True
+
+            for _sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    old_handlers[_sig] = signal.signal(_sig, _on_preempt)
+                except ValueError:
+                    pass  # not the main thread: periodic ckpts still work
+
+        try:
+            self._fit_epochs(
+                fit_data, train_data, eval_data, eval_metric,
+                validation_metric, begin_epoch, num_epoch, monitor,
+                batch_end_callback, epoch_end_callback, eval_end_callback,
+                eval_batch_end_callback, fit_k, _queue_metric,
+                _drain_metrics, _after_steps, ckpt_mgr, loop, _capture,
+                resume_skip, resume_metric)
+        finally:
+            for _sig, handler in old_handlers.items():
+                try:
+                    signal.signal(_sig, handler)
+                except ValueError:
+                    pass
+            if ckpt_mgr is not None:
+                ckpt_mgr.wait()
+
+    def _fit_epochs(self, fit_data, train_data, eval_data, eval_metric,
+                    validation_metric, begin_epoch, num_epoch, monitor,
+                    batch_end_callback, epoch_end_callback,
+                    eval_end_callback, eval_batch_end_callback, fit_k,
+                    _queue_metric, _drain_metrics, _after_steps, ckpt_mgr,
+                    loop, _capture, resume_skip, resume_metric):
+        """Epoch loop body of :meth:`fit` (split out so the signal-window
+        try/finally in fit stays readable)."""
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
+            skip = resume_skip if epoch == begin_epoch else 0
+            if skip and resume_metric is not None:
+                # resumed mid-epoch: reinstate the interrupted epoch's
+                # accumulation AFTER reset(), via __dict__.update so the
+                # validation_metric alias keeps pointing at the live
+                # object — final epoch stats then match the
+                # uninterrupted run exactly
+                eval_metric.__dict__.update(
+                    pickle.loads(resume_metric).__dict__)
+            if skip:
+                # already-trained batches are skipped, never re-fed:
+                # they consumed no RNG and must consume none on resume
+                fit_data.skip(skip)
             pending = []  # (nbatch, data_batch) awaiting a K-group flush
 
             def _flush_group(pending, epoch, eval_metric):
@@ -259,6 +439,10 @@ class BaseModule(object):
                         _queue_metric(db)
                         _fire(batch_end_callback, epoch, nbatch,
                               eval_metric, _cb_locals(nbatch, db))
+                    # the K-group is atomic (one XLA dispatch applied all
+                    # K updates), so step bookkeeping — and any interval
+                    # / preemption checkpoint — lands on its boundary
+                    _after_steps(epoch, pending[-1][0] + 1, len(pending))
                 else:
                     # partial trailing group: single-step path (already
                     # compiled; a one-off K'-step compile isn't worth it)
@@ -273,8 +457,9 @@ class BaseModule(object):
                         _queue_metric(db)
                         _fire(batch_end_callback, epoch, nbatch,
                               eval_metric, _cb_locals(nbatch, db))
+                        _after_steps(epoch, nbatch + 1, 1)
 
-            for nbatch, data_batch in enumerate(fit_data):
+            for nbatch, data_batch in enumerate(fit_data, start=skip):
                 use_multi = (
                     fit_k > 1 and monitor is None
                     and getattr(self, "_fused_trainer", None) is not None
@@ -309,6 +494,7 @@ class BaseModule(object):
                     monitor.toc_print()
                 _fire(batch_end_callback, epoch, nbatch, eval_metric,
                       locals())
+                _after_steps(epoch, nbatch + 1, 1)
             if pending:
                 _flush_group(pending, epoch, eval_metric)
                 pending = []
@@ -338,6 +524,13 @@ class BaseModule(object):
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
+
+            if ckpt_mgr is not None and loop["gs"] > loop["last_saved"]:
+                # epoch-boundary snapshot (always, interval or not):
+                # records epoch+1/batch 0 so a resume starts the next
+                # epoch cleanly. Async — the save overlaps eval/reset.
+                loop["last_saved"] = loop["gs"]
+                ckpt_mgr.save_async(_capture(epoch + 1, 0), loop["gs"])
 
             fit_data.reset()  # resets train_data through the feed wrapper
 
@@ -383,10 +576,15 @@ class BaseModule(object):
         )
 
     def save_params(self, fname):
+        from ..resilience.checkpoint import atomic_file
+
         arg_params, aux_params = self.get_params()
         blob = {"arg:" + k: v for k, v in arg_params.items()}
         blob.update({"aux:" + k: v for k, v in aux_params.items()})
-        nd.save(fname, blob)
+        # atomic: a crash mid-write must not leave a truncated .params
+        # where a previous good one (or nothing) used to be
+        with atomic_file(fname) as f:
+            nd._save_fileobj(f, blob)
 
     def load_params(self, fname):
         split = {"arg": {}, "aux": {}}
@@ -417,6 +615,23 @@ class BaseModule(object):
 
     def update_metric(self, eval_metric, labels):
         raise NotImplementedError()
+
+    def _capture_train_state(self):
+        """Checkpoint hook: snapshot everything this module needs for an
+        exact resume. The generic default covers params only; Module
+        overrides it to add optimizer state and the fused device dicts."""
+        arg, aux = self.get_params()
+        return {
+            "arg": {k: v.asnumpy().copy() for k, v in arg.items()},
+            "aux": {k: v.asnumpy().copy() for k, v in aux.items()},
+            "opt": {"kind": "none"},
+        }
+
+    def _restore_train_state(self, blob):
+        """Checkpoint hook: inverse of :meth:`_capture_train_state`."""
+        self.set_params(
+            {k: nd.array(v) for k, v in (blob.get("arg") or {}).items()},
+            {k: nd.array(v) for k, v in (blob.get("aux") or {}).items()})
 
     def _metric_snapshot(self):
         """Deferred-metric hook for fit()'s MXTPU_METRIC_INTERVAL path:

@@ -156,8 +156,13 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """Parity module.py:127."""
-        self._symbol.save("%s-symbol.json" % prefix)
+        """Parity module.py:127. Every file goes through the atomic
+        writer (via save_params / save_optimizer_states) so a crash
+        mid-save never leaves a truncated artifact in place."""
+        from ..resilience.checkpoint import atomic_file
+
+        with atomic_file("%s-symbol.json" % prefix, mode="w") as f:
+            f.write(self._symbol.tojson())
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
         logging.info("Saved checkpoint to \"%s\"", param_name)
@@ -828,30 +833,153 @@ class Module(BaseModule):
             self._exec_group.get_params(self._arg_params, self._aux_params)
             self._params_dirty = False
 
+    def _capture_train_state(self):
+        """Consistent snapshot of params + optimizer state for the atomic
+        checkpointer (resilience/checkpoint.py).
+
+        Fused path: params/aux/opt are immutable jax.Arrays rebound each
+        step, but the compiled step DONATES them (train_step.py
+        donate_argnums), so a raw reference captured here is deleted the
+        moment the next step dispatches. Snapshot device-side copies
+        instead: an async device-to-device pass that owns fresh buffers,
+        still without any host pull on the train thread — the checkpoint
+        writer thread does the blocking host transfers. Executor path:
+        arrays are mutated in place, so the snapshot copies to host here.
+        """
+        assert self.binded and self.params_initialized
+        if self._fused_trainer is not None:
+            import jax
+
+            def _copy(tree):
+                # a + 0 forces fresh output buffers (never aliased to the
+                # donated inputs); dtype-preserving for float/int arrays
+                return jax.tree_util.tree_map(lambda a: a + 0, tree)
+
+            owner = self._fused_owner
+            return {
+                "arg": _copy(dict(owner._fused_params)),
+                "aux": _copy(dict(owner._fused_aux)),
+                "opt": {"kind": "fused", "t": owner._fused_t,
+                        "state": _copy(dict(owner._fused_opt))},
+            }
+        arg, aux = self.get_params()
+        state = {
+            "arg": {k: np.array(v.asnumpy()) for k, v in arg.items()},
+            "aux": {k: np.array(v.asnumpy()) for k, v in aux.items()},
+            "opt": {"kind": "none"},
+        }
+        if not self.optimizer_initialized:
+            return state
+        if self._kvstore is not None:
+            # in-flight async push/pull ops still mutate updater state;
+            # quiesce the comm engine so the snapshot is a step boundary
+            self._kvstore._comm.wait_for_all()
+        updater = (self._kvstore._updater if self._update_on_kvstore
+                   else self._updater)
+        if updater is not None:
+            state["opt"] = {"kind": "updater", "bytes": updater.get_states()}
+        return state
+
+    def _restore_train_state(self, blob):
+        """Inverse of :meth:`_capture_train_state` over a host-side blob
+        (numpy trees from checkpoint load): params back onto devices,
+        optimizer state re-placed, fused executors marked stale."""
+        assert self.binded and self.params_initialized
+        arg = {k: nd.array(v) for k, v in (blob.get("arg") or {}).items()}
+        aux = {k: nd.array(v) for k, v in (blob.get("aux") or {}).items()}
+        self.set_params(arg, aux)
+        if self._fused_trainer is not None:
+            owner = self._fused_owner
+            owner._fused_params, owner._fused_aux = (
+                owner._fused_trainer.place_params(
+                    self._arg_params, self._aux_params))
+            if self is not owner:
+                self._fused_params = owner._fused_params
+                self._fused_aux = owner._fused_aux
+            owner._fused_exec_stale = True
+            self._fused_exec_stale = True
+        opt = blob.get("opt") or {"kind": "none"}
+        kind = opt.get("kind", "none")
+        if kind == "fused":
+            if self._fused_trainer is None:
+                raise MXNetError(
+                    "checkpoint carries fused optimizer state but this "
+                    "module trains on the executor path — rebind with a "
+                    "device kvstore (or retrain) to resume it")
+            self._place_fused_opt_state(opt["t"], opt["state"])
+        elif kind == "updater":
+            if self._fused_trainer is not None:
+                raise MXNetError(
+                    "checkpoint carries executor-path optimizer state but "
+                    "this module trains on the fused path — resume with "
+                    "the same kvstore type it was saved under")
+            if self._kvstore is not None:
+                self._kvstore._comm.wait_for_all()
+            updater = (self._kvstore._updater if self._update_on_kvstore
+                       else self._updater)
+            if updater is None:
+                raise MXNetError(
+                    "checkpoint carries optimizer state but no updater is "
+                    "initialized — call init_optimizer before restoring")
+            updater.set_states(opt["bytes"])
+
+    def _fused_opt_host_state(self):
+        """Fused optimizer state pulled to host: {"t": int, "state":
+        {name: nested numpy tuples}} — the on-disk payload shape shared
+        by save_optimizer_states and the checkpoint subsystem."""
+        owner = self._fused_owner
+
+        def _host(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(_host(x) for x in s)
+            return np.asarray(s)
+
+        return {"t": owner._fused_t,
+                "state": {k: _host(v) for k, v in owner._fused_opt.items()}}
+
+    def _place_fused_opt_state(self, t, state_tree):
+        """Place a host optimizer-state tree back onto the fused
+        trainer's shardings (shared by load_optimizer_states and
+        checkpoint resume)."""
+        import jax
+
+        owner = self._fused_owner
+        trainer = owner._fused_trainer
+
+        def _place(name, s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(_place(name, x) for x in s)
+            return jax.device_put(
+                s, trainer._state_sharding_for(name, s)
+            )
+
+        owner._fused_t = int(t)
+        owner._fused_opt = {
+            k: _place(k, v) for k, v in state_tree.items()
+        }
+        if self is not owner:
+            self._fused_t = owner._fused_t
+            self._fused_opt = owner._fused_opt
+
     def save_optimizer_states(self, fname):
-        """Parity module.py:674."""
+        """Parity module.py:674 — atomic write (temp + fsync + rename)."""
+        from ..resilience.checkpoint import atomic_file
+
         assert self.optimizer_initialized
         if self._fused_trainer is not None:
             import pickle
 
-
-            owner = self._fused_owner
-
-            def _host(s):
-                if s is None:
-                    return None
-                if isinstance(s, tuple):
-                    return tuple(_host(x) for x in s)
-                return np.asarray(s)
-
-            state = {k: _host(v) for k, v in owner._fused_opt.items()}
-            with open(fname, "wb") as fout:
-                pickle.dump({"t": owner._fused_t, "state": state}, fout)
+            with atomic_file(fname) as fout:
+                pickle.dump(self._fused_opt_host_state(), fout)
             return
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            with atomic_file(fname) as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
@@ -859,26 +987,9 @@ class Module(BaseModule):
         if self._fused_trainer is not None:
             import pickle
 
-            import jax
-
-            owner = self._fused_owner
             with open(fname, "rb") as fin:
                 blob = pickle.load(fin)
-            owner._fused_t = blob["t"]
-            trainer = owner._fused_trainer
-
-            def _place(name, s):
-                if s is None:
-                    return None
-                if isinstance(s, tuple):
-                    return tuple(_place(name, x) for x in s)
-                return jax.device_put(
-                    s, trainer._state_sharding_for(name, s)
-                )
-
-            owner._fused_opt = {
-                k: _place(k, v) for k, v in blob["state"].items()
-            }
+            self._place_fused_opt_state(blob["t"], blob["state"])
             return
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)

@@ -96,7 +96,13 @@ class HeartbeatWriter:
 
     def start(self):
         if self._thread is not None:
-            return self
+            if self._thread.is_alive() and not self._stop.is_set():
+                return self  # already beating
+            # Previous thread is winding down (stop() timed out before
+            # it exited) or already finished; wait it out and reap it so
+            # two beaters never run at once.
+            self._thread.join()
+            self._thread = None
         self._stop.clear()  # writers are restartable (stop() then start())
         self._beat()
         self.progress()
@@ -109,6 +115,12 @@ class HeartbeatWriter:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self._interval + 1.0)
+            if self._thread.is_alive():
+                # Join timed out: the thread is still winding down (e.g.
+                # blocked in a slow _touch). Keep the handle so start()
+                # cannot spawn a second beater alongside it; the next
+                # start() reaps it once it exits.
+                return
             self._thread = None
 
     def progress(self, ticks=1):

@@ -21,6 +21,11 @@ import numpy as np
 
 from .. import telemetry as _tm
 
+try:
+    from ..resilience import fault as _fault
+except ImportError:  # standalone import by path (tools helpers)
+    _fault = None
+
 _H_COLLECTIVE_SECONDS = _tm.histogram(
     "parallel.collective_seconds",
     "Host-observed latency of explicit cross-process collectives "
@@ -146,6 +151,13 @@ def allreduce_sum(value):
     inj_ms = _injected_latency_ms()  # warns once when the knob is live
     if inj_ms:
         _time_mod.sleep(inj_ms / 1000.0)
+    if _fault is not None and _fault.configured():
+        # MXTPU_FAULT_INJECT delay_collective_ms: the slow/hung-peer
+        # class the watchdog's progress staleness signal must catch.
+        # Collectives are never retried (peers issue them in lockstep;
+        # re-entering one a peer already left deadlocks the mesh), so
+        # delay is the only injectable fault here.
+        _fault.fire("collective")
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -30,6 +30,19 @@ def seed(seed_state: int):
     _state.key = jax.random.PRNGKey(int(seed_state))
 
 
+def get_state():
+    """Snapshot the global key stream as a host array — the checkpoint
+    subsystem stores this for bitwise-exact resume."""
+    return _np.asarray(_get_state())
+
+
+def set_state(key):
+    """Restore a key stream captured by :func:`get_state`."""
+    import jax.numpy as jnp
+
+    _state.key = jnp.asarray(key)
+
+
 def next_key():
     """Split a fresh subkey off the global stream."""
     import jax

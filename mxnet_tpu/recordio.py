@@ -16,6 +16,8 @@ import struct
 import numpy as np
 
 from .base import MXNetError
+from .resilience import fault as _fault
+from .resilience import retry as _retry
 
 _MAGIC = 0xCED7230A
 _KMAGIC_STRUCT = struct.Struct("<II")
@@ -61,9 +63,13 @@ class MXRecordIO(object):
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            # interpreter teardown: builtins (open) may already be gone;
-            # an unflushed idx of a leaked writer is the caller's bug
+        except (OSError, ValueError, AttributeError, TypeError, NameError):
+            # interpreter teardown: builtins (open) may already be gone
+            # (NameError/AttributeError/TypeError) or the fd is already
+            # unusable (OSError/ValueError on a closed file); an
+            # unflushed idx of a leaked writer is the caller's bug.
+            # Anything else (e.g. corruption raised from a close-time
+            # flush) propagates.
             pass
 
     def reset(self):
@@ -81,18 +87,40 @@ class MXRecordIO(object):
 
     def read(self):
         assert not self.writable
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = _KMAGIC_STRUCT.unpack(header)
-        if magic != _MAGIC:
-            raise MXNetError("invalid record magic in %s" % self.uri)
-        _, length = _decode_lrec(lrec)
-        buf = self.handle.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.handle.read(pad)
-        return buf
+        start = self.handle.tell()
+
+        def _attempt():
+            # A transient read error mid-record must not leave the
+            # cursor between fields — rewind so the retry re-reads the
+            # whole record.
+            self.handle.seek(start)
+            _fault.fire("recordio_read", uri=self.uri, offset=start)
+            header = self.handle.read(8)
+            if not header:
+                return None  # clean EOF on a record boundary
+            if len(header) < 8:
+                raise MXNetError(
+                    "%s: truncated record header at offset %d "
+                    "(%d of 8 bytes)" % (self.uri, start, len(header)))
+            magic, lrec = _KMAGIC_STRUCT.unpack(header)
+            if magic != _MAGIC:
+                raise MXNetError(
+                    "%s: invalid record magic 0x%08x at offset %d"
+                    % (self.uri, magic, start))
+            _, length = _decode_lrec(lrec)
+            buf = self.handle.read(length)
+            if len(buf) < length:
+                raise MXNetError(
+                    "%s: truncated record payload at offset %d "
+                    "(%d of %d bytes)" % (self.uri, start, len(buf), length))
+            pad = (4 - length % 4) % 4
+            if pad and len(self.handle.read(pad)) < pad:
+                raise MXNetError(
+                    "%s: truncated record padding at offset %d"
+                    % (self.uri, start))
+            return buf
+
+        return _retry.call(_attempt, name="recordio.read")
 
     def tell(self):
         return self.handle.tell()
@@ -142,7 +170,13 @@ class MXIndexedRecordIO(MXRecordIO):
                     o = ord_by_payload.get(self.idx[k] + 8)
                     if o is not None:
                         self._key_to_ord[k] = o
-            except Exception:
+            except (ImportError, OSError, MXNetError):
+                # The native mmap reader is an optional fast path: a
+                # missing extension, an unreadable file, or a format the
+                # native indexer rejects all fall back to the pure-python
+                # seek+read path. Index corruption surfaces from
+                # read()/read_idx() with offset context instead of being
+                # masked here.
                 self._native = None
                 self._key_to_ord = {}
 

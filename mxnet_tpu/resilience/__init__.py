@@ -1,0 +1,24 @@
+"""Fault tolerance for preemptible TPU training.
+
+Four coordinated pieces (see docs/robustness.md):
+
+* :mod:`.checkpoint` — atomic full-state checkpoints (temp + fsync +
+  rename, CRC32 manifest, keep-last-N) and valid-checkpoint discovery.
+* Preemption handling — ``Module.fit`` installs SIGTERM/SIGINT handlers
+  when checkpointing is enabled, drains in-flight dispatch, writes a
+  final checkpoint, and exits with :data:`EXIT_PREEMPTED`.
+* Auto-resume — ``fit(..., checkpoint_dir=..., resume="auto")`` restores
+  params, optimizer state, RNG, metrics, and data-iterator position from
+  the newest checkpoint that verifies, for bitwise-exact continuation.
+* :mod:`.retry` — jittered-exponential-backoff retries with transient
+  error classification, shared by kvstore, recordio, and checkpoint I/O.
+
+:mod:`.fault` is the test-only injection switchboard driving the
+crash-resume integration suite (``MXTPU_FAULT_INJECT``).
+"""
+from . import checkpoint, fault, retry  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    EXIT_PREEMPTED, CheckpointError, CheckpointManager, atomic_file,
+    list_checkpoints, load_state, verify_checkpoint,
+)
+from .retry import TransientError, is_retryable  # noqa: F401

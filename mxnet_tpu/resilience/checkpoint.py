@@ -1,0 +1,492 @@
+"""Atomic full-state training checkpoints with manifest verification.
+
+Format: one directory per checkpoint, ``<dir>/ckpt-<step 12 digits>/``::
+
+    state.params     params + aux in the reference .params container
+                     (keys "arg:<name>" / "aux:<name>", so the file is
+                     loadable by plain ``mx.nd.load`` too)
+    optimizer.state  pickled optimizer payload (fused host tree, updater
+                     bytes, or {"kind": "none"})
+    train_state.pkl  pickled loop position: epoch, nbatch, global_step,
+                     metric state, RNG (numpy MT state + jax PRNGKey)
+    MANIFEST.json    written LAST: per-file byte counts + CRC32 and
+                     per-tensor CRC32s. A directory without a readable,
+                     matching manifest is by definition torn and is
+                     never resumed from.
+
+Atomicity protocol (the TensorFlow-checkpoint recovery model, done with
+POSIX primitives): build everything in a ``.tmp-*`` sibling dir, fsync
+each file, write the manifest last, ``os.replace`` the dir into its
+final name, fsync the parent. Readers either see a complete checkpoint
+or none; a crash at ANY byte leaves only a ``.tmp-*`` that retention
+sweeps away. Verification re-hashes on read, so silent storage
+corruption (torn page after the rename) is also caught and skipped by
+``latest_valid()``.
+
+Snapshot cost model: the caller (Module.fit) captures device-array
+references on the train thread — immutable jax.Arrays make a dict copy
+a consistent zero-cost snapshot — and ``save_async`` does the host
+pulls, hashing, and fsyncs on a background thread so the step loop
+barely stalls.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import pickle
+import re
+import shutil
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from . import fault, retry
+
+try:
+    from .. import telemetry as _tm
+except ImportError:  # standalone import (tools/ckpt_inspect.py by path)
+    _tm = None
+
+#: Exit code for "preempted after writing a final checkpoint" — EX_TEMPFAIL,
+#: the sysexits.h "transient failure, retry the job" code. Supervisors
+#: (tools/watchdog.py, k8s restart policies) can distinguish this from a
+#: real training failure.
+EXIT_PREEMPTED = 75
+
+ENV_INTERVAL = "MXTPU_CKPT_INTERVAL"
+ENV_KEEP = "MXTPU_CKPT_KEEP"
+
+MANIFEST = "MANIFEST.json"
+PARAMS_FILE = "state.params"
+OPT_FILE = "optimizer.state"
+TRAIN_FILE = "train_state.pkl"
+_FORMAT_VERSION = 1
+
+_CKPT_RE = re.compile(r"^ckpt-(\d{12})$")
+
+log = logging.getLogger(__name__)
+
+
+def _metric(kind, name, help_):
+    if _tm is None:
+        return None
+    return getattr(_tm, kind)(name, help_)
+
+
+_H_WRITE_S = _metric("histogram", "checkpoint.write_seconds",
+                     "Wall seconds to build+fsync+publish one checkpoint")
+_C_BYTES = _metric("counter", "checkpoint.bytes",
+                   "Bytes written into published checkpoints")
+_C_WRITTEN = _metric("counter", "checkpoint.written",
+                     "Checkpoints successfully published")
+_C_FAILED = _metric("counter", "checkpoint.failed",
+                    "Checkpoint attempts that aborted (no partial state "
+                    "is ever published)")
+_C_SKIPPED = _metric("counter", "resume.skipped_corrupt",
+                     "Checkpoints skipped by latest_valid() for failing "
+                     "manifest verification")
+
+
+class CheckpointError(Exception):
+    """A checkpoint exists but cannot be trusted (torn, corrupt, or an
+    incompatible format version)."""
+
+
+class _HostArray:
+    """Minimal .asnumpy() carrier so ndarray._save_fileobj can serialize
+    host snapshots without constructing device-backed NDArrays."""
+
+    __slots__ = ("_a",)
+
+    def __init__(self, a):
+        self._a = np.asarray(a)
+
+    def asnumpy(self):
+        return self._a
+
+
+@contextlib.contextmanager
+def atomic_file(path, mode="wb"):
+    """Write ``path`` all-or-nothing: temp file in the same directory,
+    flush + fsync, then ``os.replace`` over the target and fsync the
+    parent dir. On any error the temp file is removed and the previous
+    ``path`` (if any) is left untouched."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(
+        directory, ".tmp-%s-%d" % (os.path.basename(path), os.getpid()))
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        _fsync_dir(directory)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            f.close()
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def _fsync_dir(path):
+    # Directory fsync makes the rename itself durable. Some filesystems
+    # refuse O_RDONLY dir fsync; crash-consistency degrades gracefully.
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _crc_file(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _write_member(ckpt_dir, name, payload):
+    """Write one checkpoint member durably; returns (bytes, crc32).
+
+    The write itself goes through the shared retry policy — a transient
+    EIO from flaky network storage should cost a backoff, not the whole
+    snapshot — while ENOSPC and friends abort the attempt immediately.
+    """
+    path = os.path.join(ckpt_dir, name)
+
+    def _do():
+        fault.fire("ckpt_write", path=path)
+        with open(path, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+
+    retry.call(_do, name="ckpt.write")
+    return len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def step_dir(directory, step):
+    return os.path.join(directory, "ckpt-%012d" % int(step))
+
+
+def list_checkpoints(directory):
+    """All checkpoint step numbers present (valid or not), ascending."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return []
+    steps = []
+    for name in entries:
+        m = _CKPT_RE.match(name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def read_manifest(path):
+    with open(os.path.join(path, MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("version") != _FORMAT_VERSION:
+        raise CheckpointError(
+            "%s: unsupported checkpoint format version %r"
+            % (path, manifest.get("version")))
+    return manifest
+
+
+def verify_checkpoint(path, deep=False):
+    """Check a checkpoint directory against its manifest.
+
+    Shallow (default): every listed file exists with the recorded size
+    and whole-file CRC32 — catches truncation and torn writes. ``deep``
+    additionally re-hashes every individual tensor payload against the
+    per-tensor CRCs (catches in-place bit corruption localized to one
+    array). Returns the manifest; raises :class:`CheckpointError`.
+    """
+    try:
+        manifest = read_manifest(path)
+    except CheckpointError:
+        raise
+    except (OSError, ValueError) as exc:
+        raise CheckpointError("%s: unreadable manifest: %s" % (path, exc))
+    for name, meta in manifest.get("files", {}).items():
+        fpath = os.path.join(path, name)
+        try:
+            size = os.path.getsize(fpath)
+        except OSError:
+            raise CheckpointError("%s: missing member %s" % (path, name))
+        if size != meta["bytes"]:
+            raise CheckpointError(
+                "%s: %s is %d bytes, manifest says %d (torn write)"
+                % (path, name, size, meta["bytes"]))
+        if _crc_file(fpath) != meta["crc32"]:
+            raise CheckpointError(
+                "%s: %s fails CRC32 (corrupt)" % (path, name))
+    if deep:
+        _verify_tensors(path, manifest)
+    return manifest
+
+
+def _verify_tensors(path, manifest):
+    from .. import ndarray as nd
+
+    arrays = nd.load(os.path.join(path, PARAMS_FILE))
+    for key, want in manifest.get("tensors", {}).items():
+        arr = arrays.get(key)
+        if arr is None:
+            raise CheckpointError("%s: tensor %s missing" % (path, key))
+        got = zlib.crc32(
+            np.ascontiguousarray(arr.asnumpy()).tobytes()) & 0xFFFFFFFF
+        if got != want:
+            raise CheckpointError(
+                "%s: tensor %s fails CRC32 (corrupt)" % (path, key))
+
+
+def load_state(path, verify=True):
+    """Read a checkpoint directory back into the state dict shape that
+    :meth:`CheckpointManager.save` accepted."""
+    if verify:
+        verify_checkpoint(path)
+    from .. import ndarray as nd
+
+    arrays = nd.load(os.path.join(path, PARAMS_FILE))
+    arg = {}
+    aux = {}
+    for key, arr in arrays.items():
+        kind, _, name = key.partition(":")
+        (arg if kind == "arg" else aux)[name] = arr.asnumpy()
+    with open(os.path.join(path, OPT_FILE), "rb") as f:
+        opt = pickle.load(f)
+    with open(os.path.join(path, TRAIN_FILE), "rb") as f:
+        train = pickle.load(f)
+    state = dict(train)
+    state["module"] = {"arg": arg, "aux": aux, "opt": opt}
+    return state
+
+
+class CheckpointManager:
+    """Owns one checkpoint directory: atomic writes, retention,
+    background snapshots, and valid-checkpoint discovery.
+
+    ``state`` dicts passed to :meth:`save` look like::
+
+        {"module": {"arg": {name: array-like}, "aux": {...},
+                    "opt": <picklable>},
+         "epoch": int, "nbatch": int, "global_step": int,
+         "metric": bytes|None, "rng": {...}}
+
+    Array-likes need only ``np.asarray()`` to work — numpy arrays,
+    jax.Arrays, and NDArrays all qualify.
+    """
+
+    def __init__(self, directory, keep=None):
+        self.directory = directory
+        if keep is None:
+            try:
+                keep = int(os.environ.get(ENV_KEEP, 3))
+            except ValueError:
+                keep = 3
+        self.keep = max(1, int(keep))
+        self.last_step = None
+        self._thread = None
+        self._last_error = None
+        os.makedirs(directory, exist_ok=True)
+
+    # -- write side -----------------------------------------------------
+
+    def save(self, state, step):
+        """Synchronously publish ``state`` as checkpoint ``step``.
+
+        Returns the published directory. Raises on failure; a failed
+        attempt never leaves a partial ``ckpt-*`` dir behind.
+        """
+        self.wait()
+        step = int(step)
+        final = step_dir(self.directory, step)
+        if os.path.isdir(final):
+            # Step already checkpointed (interval boundary coinciding
+            # with epoch end): publishing twice would tear the existing
+            # good copy for zero information gain.
+            return final
+        t0 = time.monotonic()
+        tmp = os.path.join(
+            self.directory, ".tmp-%012d-%d" % (step, os.getpid()))
+        try:
+            total = self._build(tmp, state, step)
+            os.replace(tmp, final)
+            _fsync_dir(self.directory)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            if _C_FAILED:
+                _C_FAILED.inc()
+            raise
+        dt = time.monotonic() - t0
+        if _H_WRITE_S:
+            _H_WRITE_S.observe(dt)
+        if _C_BYTES:
+            _C_BYTES.inc(total)
+        if _C_WRITTEN:
+            _C_WRITTEN.inc()
+        self.last_step = step
+        fault.fire("ckpt_done", path=final)
+        self._retain()
+        return final
+
+    def save_async(self, state, step):
+        """Publish on a background thread. Waits for any previous
+        in-flight snapshot first (at most one outstanding). Failures are
+        logged and counted, not raised — a flaky periodic snapshot must
+        not kill the training loop; the final/preemption checkpoint uses
+        synchronous :meth:`save` which does raise."""
+        self.wait()
+
+        def _run():
+            try:
+                self.save(state, step)
+            except BaseException as exc:  # noqa: B036 - logged, counted
+                self._last_error = exc
+                log.warning("async checkpoint at step %d failed: %s",
+                            step, exc)
+
+        self._thread = threading.Thread(
+            target=_run, name="mxtpu-ckpt", daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def wait(self):
+        """Block until any in-flight async snapshot has finished."""
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join()
+            self._thread = None
+
+    def _build(self, tmp, state, step):
+        os.makedirs(tmp, exist_ok=True)
+        module = state.get("module") or {}
+        files = {}
+        tensors = {}
+
+        payload, tensors = _pack_params(
+            module.get("arg") or {}, module.get("aux") or {})
+        files[PARAMS_FILE] = _member_meta(
+            *_write_member(tmp, PARAMS_FILE, payload))
+        # Optimizer state may arrive as device-array references (fused
+        # path snapshots are reference copies); the blocking host pull
+        # happens here, on the writer thread.
+        opt = _host_tree(module.get("opt") or {"kind": "none"})
+        files[OPT_FILE] = _member_meta(*_write_member(
+            tmp, OPT_FILE, pickle.dumps(opt, protocol=2)))
+        train = {k: v for k, v in state.items() if k != "module"}
+        files[TRAIN_FILE] = _member_meta(
+            *_write_member(tmp, TRAIN_FILE, pickle.dumps(train, protocol=2)))
+
+        manifest = {
+            "version": _FORMAT_VERSION,
+            "step": step,
+            "time": time.time(),
+            "files": files,
+            "tensors": tensors,
+        }
+        payload = json.dumps(manifest, indent=1, sort_keys=True).encode()
+        _write_member(tmp, MANIFEST, payload)
+        return sum(m["bytes"] for m in files.values()) + len(payload)
+
+    def _retain(self):
+        steps = list_checkpoints(self.directory)
+        for step in steps[:-self.keep] if len(steps) > self.keep else []:
+            shutil.rmtree(step_dir(self.directory, step),
+                          ignore_errors=True)
+        # Sweep orphaned build dirs from crashed writers (not ours: a
+        # concurrent writer pid could be mid-build, but stale pids from
+        # dead processes dominate and rebuilds are cheap).
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        suffix = "-%d" % os.getpid()
+        for name in entries:
+            if name.startswith(".tmp-") and not name.endswith(suffix):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # -- read side ------------------------------------------------------
+
+    def latest_valid(self, deep=False):
+        """Newest checkpoint that verifies, or None. Torn/corrupt
+        candidates are skipped (counted in ``resume.skipped_corrupt``)
+        and the scan falls back to the previous one — the acceptance
+        behavior for a truncated newest checkpoint."""
+        for step in reversed(list_checkpoints(self.directory)):
+            path = step_dir(self.directory, step)
+            try:
+                verify_checkpoint(path, deep=deep)
+                return path
+            except CheckpointError as exc:
+                if _C_SKIPPED:
+                    _C_SKIPPED.inc()
+                log.warning("skipping corrupt checkpoint %s: %s", path, exc)
+        return None
+
+    def load(self, step=None):
+        """Load checkpoint ``step`` (default: latest valid). Returns the
+        state dict, or None when ``step`` is None and nothing valid
+        exists."""
+        if step is None:
+            path = self.latest_valid()
+            if path is None:
+                return None
+        else:
+            path = step_dir(self.directory, step)
+        return load_state(path)
+
+
+def _member_meta(nbytes, crc):
+    return {"bytes": nbytes, "crc32": crc}
+
+
+def _host_tree(obj):
+    """Recursively pull a state tree to picklable host values (device
+    arrays -> numpy, containers preserved, scalars/bytes passed through)."""
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, dict):
+        return {k: _host_tree(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_host_tree(v) for v in obj)
+    if isinstance(obj, list):
+        return [_host_tree(v) for v in obj]
+    if hasattr(obj, "asnumpy"):
+        return np.asarray(obj.asnumpy())
+    return np.asarray(obj)
+
+
+def _pack_params(arg, aux):
+    """Serialize {name: array-like} dicts to reference .params bytes plus
+    per-tensor CRC32s. Host transfer happens here (np.asarray pulls
+    jax.Arrays off device) — call on the background thread."""
+    from .. import ndarray as nd
+
+    data = {}
+    tensors = {}
+    for prefix, source in (("arg", arg), ("aux", aux)):
+        for name, value in source.items():
+            host = np.ascontiguousarray(np.asarray(
+                value.asnumpy() if hasattr(value, "asnumpy") else value))
+            key = "%s:%s" % (prefix, name)
+            data[key] = _HostArray(host)
+            tensors[key] = zlib.crc32(host.tobytes()) & 0xFFFFFFFF
+    return nd.save_buffer(data), tensors

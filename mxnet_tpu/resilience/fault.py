@@ -1,0 +1,160 @@
+"""MXTPU_FAULT_INJECT: deterministic fault injection for resilience tests.
+
+The production fault-tolerance story (atomic checkpoints, retry with
+backoff, preemption handling) is only trustworthy if it is exercised by
+the same classes of failure it claims to survive. This module is the
+single switchboard: instrumented sites call ``fire(point, ...)`` and the
+``MXTPU_FAULT_INJECT`` spec decides whether that call dies, raises, or
+delays. With the env var unset every ``fire`` is a one-dict-lookup no-op,
+so the hooks are safe to leave in hot paths.
+
+Spec grammar: comma-separated ``directive=value`` pairs, e.g.::
+
+    MXTPU_FAULT_INJECT="kill_at_step=7,enospc_at_ckpt_write=1"
+
+Directives (value is always an integer):
+
+=======================  ====================================================
+``kill_at_step=K``       SIGKILL this process when optimizer step K completes
+                         (fit's ``step`` point) — the preemptible-pool worker
+                         loss that leaves NO chance to clean up.
+``exit_at_step=K``       ``os._exit(77)`` at step K — abrupt but signal-free.
+``preempt_at_step=K``    SIGTERM self at step K — drives the graceful
+                         preemption drain instead of the hard kill.
+``enospc_at_ckpt_write=N``  The N-th checkpoint file write raises
+                         ``OSError(ENOSPC)`` (non-retryable: the atomic
+                         writer must abort and leave prior checkpoints
+                         intact).
+``fail_ckpt_write=N``    The first N checkpoint file writes raise a
+                         transient ``OSError(EIO)`` — the retry wrapper is
+                         expected to absorb them.
+``truncate_ckpt=1``      After the next checkpoint finalizes, truncate its
+                         params file in place — the torn-storage case
+                         resume must skip.
+``delay_collective_ms=M``  Sleep M ms inside every cross-process collective
+                         (the delayed-collective hang class).
+``fail_recordio_read=N`` First N recordio reads raise transient EIO.
+``fail_kv_push=N``       First N kvstore push bodies raise transient EIO.
+``fail_kv_pull=N``       First N kvstore pull bodies raise transient EIO.
+=======================  ====================================================
+
+Counters are per-process and keyed by the raw spec string, so a
+monkeypatched spec in tests starts fresh. Stdlib-only and importable
+standalone (tools and subprocess test scripts load it by path).
+"""
+from __future__ import annotations
+
+import errno
+import os
+import signal
+import time
+
+ENV = "MXTPU_FAULT_INJECT"
+
+# (raw spec string, directive) -> times fired already
+_fired = {}
+_parse_cache = {}
+
+
+def configured():
+    """Whether any fault spec is active (the cheap hot-path guard)."""
+    return bool(os.environ.get(ENV))
+
+
+def _spec():
+    raw = os.environ.get(ENV)
+    if not raw:
+        return None, None
+    spec = _parse_cache.get(raw)
+    if spec is None:
+        spec = {}
+        for part in raw.split(","):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            key, _, val = part.partition("=")
+            try:
+                spec[key.strip()] = int(val)
+            except ValueError:
+                pass  # malformed directive: ignore, never crash the host
+        _parse_cache[raw] = spec
+    return raw, spec
+
+
+def _take(raw, directive, limit):
+    """Consume one firing budget unit; True while under ``limit``."""
+    key = (raw, directive)
+    n = _fired.get(key, 0)
+    if n >= limit:
+        return False
+    _fired[key] = n + 1
+    return True
+
+
+def _transient(msg):
+    return OSError(errno.EIO, "injected transient fault: %s" % msg)
+
+
+def fire(point, **ctx):
+    """Hit a named fault point. No-op unless MXTPU_FAULT_INJECT matches.
+
+    Points: ``step`` (ctx: step), ``ckpt_write`` (ctx: path),
+    ``ckpt_done`` (ctx: path), ``collective``, ``recordio_read``
+    (ctx: uri, offset), ``kv_push`` / ``kv_pull`` (ctx: key).
+    """
+    raw, spec = _spec()
+    if not spec:
+        return
+    if point == "step":
+        step = ctx.get("step")
+        if spec.get("kill_at_step") == step and _take(raw, "kill", 1):
+            os.kill(os.getpid(), signal.SIGKILL)
+        if spec.get("exit_at_step") == step and _take(raw, "exit", 1):
+            os._exit(77)
+        if spec.get("preempt_at_step") == step and _take(raw, "preempt", 1):
+            os.kill(os.getpid(), signal.SIGTERM)
+    elif point == "ckpt_write":
+        n = spec.get("enospc_at_ckpt_write")
+        if n is not None:
+            key = (raw, "enospc_seen")
+            seen = _fired.get(key, 0) + 1
+            _fired[key] = seen
+            if seen == n:
+                raise OSError(errno.ENOSPC,
+                              "injected ENOSPC: %s" % ctx.get("path"))
+        n = spec.get("fail_ckpt_write", 0)
+        if n and _take(raw, "fail_ckpt_write", n):
+            raise _transient("ckpt_write %s" % ctx.get("path"))
+    elif point == "ckpt_done":
+        if spec.get("truncate_ckpt", 0) and _take(raw, "truncate_ckpt", 1):
+            _truncate_params(ctx.get("path"))
+    elif point == "collective":
+        ms = spec.get("delay_collective_ms", 0)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+    elif point == "recordio_read":
+        n = spec.get("fail_recordio_read", 0)
+        if n and _take(raw, "fail_recordio_read", n):
+            raise _transient("recordio read %s@%s"
+                             % (ctx.get("uri"), ctx.get("offset")))
+    elif point == "kv_push":
+        n = spec.get("fail_kv_push", 0)
+        if n and _take(raw, "fail_kv_push", n):
+            raise _transient("kv push key=%s" % ctx.get("key"))
+    elif point == "kv_pull":
+        n = spec.get("fail_kv_pull", 0)
+        if n and _take(raw, "fail_kv_pull", n):
+            raise _transient("kv pull key=%s" % ctx.get("key"))
+
+
+def _truncate_params(ckpt_path):
+    """Tear the params file of a finalized checkpoint in half — the
+    storage-level corruption the manifest CRCs exist to catch."""
+    if not ckpt_path:
+        return
+    target = os.path.join(ckpt_path, "state.params")
+    if not os.path.isfile(target):
+        return
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(max(1, size // 2))

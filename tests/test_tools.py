@@ -165,3 +165,41 @@ def test_launch_tracker_modes_dry_run(tmp_path, capsys, monkeypatch):
         r = subprocess.run(["sh", shim], capture_output=True, text=True,
                            env=env, timeout=30)
         assert r.stdout.strip() == want, (envvar, r.stdout, r.stderr)
+
+
+def test_ckpt_inspect_cli_self_test():
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.ckpt_inspect", "--self-test"],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "self-test passed" in res.stdout
+
+
+def test_ckpt_inspect_cli_on_real_checkpoints(tmp_path, capsys):
+    from mxnet_tpu.resilience import checkpoint as ck
+    from tools import ckpt_inspect
+
+    mgr = ck.CheckpointManager(str(tmp_path), keep=5)
+    state = {
+        "module": {"arg": {"w": np.eye(3, dtype=np.float32)},
+                   "aux": {}, "opt": {"kind": "none"}},
+        "epoch": 0, "nbatch": 4, "global_step": 4,
+        "metric": None, "rng": {},
+    }
+    mgr.save(state, 4)
+
+    assert ckpt_inspect.main([str(tmp_path), "--verify"]) == 0
+    assert "OK (deep)" in capsys.readouterr().out
+
+    assert ckpt_inspect.main([str(tmp_path), "--state", "latest"]) == 0
+    out = capsys.readouterr().out
+    assert "global_step: 4" in out
+    assert "arg:w" in out
+
+    # a torn member must flip both the listing and the exit code
+    params = os.path.join(ck.step_dir(str(tmp_path), 4), ck.PARAMS_FILE)
+    with open(params, "r+b") as f:
+        f.truncate(8)
+    assert ckpt_inspect.main([str(tmp_path)]) == 1
+    assert "CORRUPT" in capsys.readouterr().out

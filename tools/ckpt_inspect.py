@@ -1,0 +1,170 @@
+"""Inspect mxnet_tpu resilience checkpoints.
+
+Operates on a checkpoint directory written by
+``mxnet_tpu.resilience.CheckpointManager`` (one ``ckpt-<step>/`` subdir
+per snapshot; see docs/robustness.md for the format). Three views:
+
+* default — one line per checkpoint: step, size, validity;
+* ``--verify`` — full verification including per-tensor CRC32 re-hash
+  (exit code 1 if any checkpoint fails);
+* ``--state <step|latest>`` — training-state summary of one checkpoint
+  (epoch/batch/step position, tensor names+shapes, optimizer kind, RNG).
+
+Usage::
+
+    python -m tools.ckpt_inspect /runs/exp1/ckpts
+    python -m tools.ckpt_inspect /runs/exp1/ckpts --verify
+    python -m tools.ckpt_inspect /runs/exp1/ckpts --state latest
+    python -m tools.ckpt_inspect --self-test
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.resilience import checkpoint as ck  # noqa: E402
+
+
+def _dir_bytes(path):
+    total = 0
+    for name in os.listdir(path):
+        try:
+            total += os.path.getsize(os.path.join(path, name))
+        except OSError:
+            pass
+    return total
+
+
+def list_dir(directory, deep=False):
+    """(lines, n_bad) listing every checkpoint and its verification
+    status; ``deep`` re-hashes tensors too."""
+    lines = []
+    bad = 0
+    steps = ck.list_checkpoints(directory)
+    if not steps:
+        return ["no checkpoints under %s" % directory], 0
+    for step in steps:
+        path = ck.step_dir(directory, step)
+        try:
+            manifest = ck.verify_checkpoint(path, deep=deep)
+            n_tensors = len(manifest.get("tensors", {}))
+            lines.append("ckpt-%012d  %9d bytes  %3d tensors  OK%s"
+                         % (step, _dir_bytes(path), n_tensors,
+                            " (deep)" if deep else ""))
+        except ck.CheckpointError as exc:
+            bad += 1
+            lines.append("ckpt-%012d  CORRUPT: %s" % (step, exc))
+    return lines, bad
+
+
+def state_summary(directory, which):
+    """Human-readable training-state summary of one checkpoint."""
+    if which == "latest":
+        mgr = ck.CheckpointManager(directory)
+        path = mgr.latest_valid()
+        if path is None:
+            raise SystemExit("no valid checkpoint under %s" % directory)
+    else:
+        path = ck.step_dir(directory, int(which))
+    manifest = ck.verify_checkpoint(path)
+    with open(os.path.join(path, ck.TRAIN_FILE), "rb") as f:
+        train = pickle.load(f)
+    with open(os.path.join(path, ck.OPT_FILE), "rb") as f:
+        opt = pickle.load(f)
+    lines = [
+        "checkpoint : %s" % path,
+        "step       : %s" % manifest.get("step"),
+        "epoch      : %s  (next batch %s)"
+        % (train.get("epoch"), train.get("nbatch")),
+        "global_step: %s" % train.get("global_step"),
+        "optimizer  : %s" % (opt.get("kind") if isinstance(opt, dict)
+                             else type(opt).__name__),
+        "metric     : %s" % ("saved (%d bytes)" % len(train["metric"])
+                             if train.get("metric") else "none"),
+        "rng        : %s" % ", ".join(sorted(
+            (train.get("rng") or {}).keys())),
+        "tensors    :",
+    ]
+    from mxnet_tpu import ndarray as nd
+
+    arrays = nd.load(os.path.join(path, ck.PARAMS_FILE))
+    for key in sorted(arrays):
+        arr = arrays[key].asnumpy()
+        lines.append("  %-28s %-14s %s"
+                     % (key, str(arr.dtype), tuple(arr.shape)))
+    return "\n".join(lines)
+
+
+def _self_test():
+    """Write, corrupt, and inspect synthetic checkpoints end to end."""
+    import tempfile
+
+    import numpy as np
+
+    d = tempfile.mkdtemp(prefix="ckpt_inspect_test_")
+    mgr = ck.CheckpointManager(d, keep=5)
+    state = {
+        "module": {
+            "arg": {"w": np.arange(12, dtype=np.float32).reshape(3, 4)},
+            "aux": {"m": np.ones(3, dtype=np.float64)},
+            "opt": {"kind": "none"},
+        },
+        "epoch": 1, "nbatch": 2, "global_step": 10,
+        "metric": None, "rng": {"numpy": np.random.get_state()},
+    }
+    mgr.save(state, 10)
+    mgr.save(state, 20)
+    lines, bad = list_dir(d, deep=True)
+    assert bad == 0 and len(lines) == 2, lines
+    assert all("OK" in ln for ln in lines), lines
+
+    text = state_summary(d, "latest")
+    assert "global_step: 10" in text, text
+    assert "arg:w" in text and "(3, 4)" in text, text
+
+    # tear the newest one; the lister must flag it and --state latest
+    # must fall back to the older valid snapshot
+    with open(os.path.join(ck.step_dir(d, 20), ck.PARAMS_FILE),
+              "r+b") as f:
+        f.truncate(16)
+    lines, bad = list_dir(d)
+    assert bad == 1, lines
+    assert any("CORRUPT" in ln for ln in lines), lines
+    text = state_summary(d, "latest")
+    assert "ckpt-%012d" % 10 in text, text
+    print("self-test passed")
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="List, verify, and summarize resilience checkpoints")
+    parser.add_argument("directory", nargs="?",
+                        help="checkpoint directory (CheckpointManager root)")
+    parser.add_argument("--verify", action="store_true",
+                        help="re-hash every file AND every tensor "
+                             "(exit 1 if any checkpoint fails)")
+    parser.add_argument("--state", metavar="STEP",
+                        help="print the training-state summary of one "
+                             "checkpoint ('latest' or a step number)")
+    parser.add_argument("--self-test", action="store_true",
+                        help="run built-in checks on synthetic checkpoints")
+    args = parser.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.directory:
+        parser.error("directory required (or --self-test)")
+    if args.state:
+        print(state_summary(args.directory, args.state))
+        return 0
+    lines, bad = list_dir(args.directory, deep=args.verify)
+    print("\n".join(lines))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

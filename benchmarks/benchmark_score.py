@@ -159,6 +159,17 @@ def main():
                             "~48 img/s fwd at batch 32 per its README era)",
         "rows": rows,
     }
+    if os.environ.get("SCORE_SHARDED_AB", "0") == "1":
+        # ISSUE 5 rider: sharded-vs-replicated weight-update A/B
+        # (update_host_ms, comm_bytes_per_step) on a small MLP — the
+        # same harness kvstore_overlap_bench.py runs at full size
+        from benchmarks.sharded_ab import run_sharded_ab
+
+        ab_dev = min(8, jax.device_count())
+        out["sharded_update_ab"] = run_sharded_ab(
+            ndev=ab_dev, batch=16 * ab_dev, in_dim=256, n_hidden=256,
+            n_layers=3, reps=3 if SMOKE else 10)
+        print(json.dumps(out["sharded_update_ab"]), file=sys.stderr)
     tag = os.environ.get("SCORE_TAG", "smoke" if SMOKE else "v5e_r4")
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "results", "benchmark_score_%s.json" % tag)

@@ -80,8 +80,15 @@ def main():
         "async_images_per_sec": round(async_rate, 1),
         "speedup": round(async_rate / sync_rate, 3),
     }
+    # ISSUE 5: sharded-vs-replicated weight-update A/B on the same MLP
+    # (update_host_ms + comm_bytes_per_step; see benchmarks/sharded_ab.py)
+    from benchmarks.sharded_ab import run_sharded_ab
+
+    out["sharded_update_ab"] = run_sharded_ab(
+        ndev=N_DEV, batch=BATCH, in_dim=512, n_hidden=512, n_layers=6,
+        reps=int(os.environ.get("OVERLAP_AB_REPS", "10")))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "results", "kvstore_overlap_cpu8_r4.json")
+                        "results", "kvstore_overlap_sharded_cpu8_r5.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

@@ -136,3 +136,47 @@ def get_env(name, default, typ=None):
     if typ is float or isinstance(default, float):
         return float(v)
     return v
+
+
+_DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
+
+def bucket_bytes_env():
+    """MXTPU_BUCKET_BYTES: size cap for coalesced gradient buckets,
+    shared by the kvstore GradBucketer and the fused flat-update plan
+    (docs/env_vars.md). Missing/empty/garbage → 4 MiB default; negative
+    clamps to 0 (0 disables coalescing: one collective per key and the
+    legacy per-param fused update)."""
+    raw = os.environ.get("MXTPU_BUCKET_BYTES")
+    if raw is None or raw == "":
+        return _DEFAULT_BUCKET_BYTES
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return _DEFAULT_BUCKET_BYTES
+
+
+def _init_compile_cache():
+    """MXTPU_COMPILE_CACHE=<dir>: turn on JAX's persistent compilation
+    cache at import, so benchmark re-runs and preemption-resumed jobs
+    (resilience/checkpoint.py auto-resume) skip XLA recompiles. The
+    thresholds drop to 0 because our programs are many small jit bodies
+    (per-key ops, fused steps) that the default 1s/too-small gates would
+    mostly skip."""
+    cache_dir = os.environ.get("MXTPU_COMPILE_CACHE")
+    if not cache_dir:
+        return
+    import jax
+
+    for knob, value in (
+        ("jax_compilation_cache_dir", cache_dir),
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except (AttributeError, ValueError):  # knob absent in this jax
+            pass
+
+
+_init_compile_cache()

@@ -50,7 +50,7 @@ from . import engine as _engine
 from . import ndarray as nd
 from . import optimizer as opt
 from . import telemetry as _tm
-from .base import MXNetError
+from .base import MXNetError, bucket_bytes_env as _env_bucket_bytes
 from .ndarray import NDArray
 from .resilience import fault as _fault
 from .resilience import retry as _retry
@@ -67,10 +67,92 @@ _H_PULL_SECONDS = _tm.histogram(
 _H_ALLREDUCE_SECONDS = _tm.histogram(
     "kvstore.allreduce_seconds", "Cross-process allreduce+update stage "
     "latency (dist stores)")
+_H_BUCKET_BYTES = _tm.histogram(
+    "kvstore.bucket_bytes", "Payload bytes per coalesced gradient bucket "
+    "(kvstore GradBucketer flushes and fused flat-update plan buckets)")
+_M_BUCKET_FLUSHES = _tm.counter(
+    "kvstore.bucket_flushes", "GradBucketer flushes (one count per "
+    "collective issued on the dist deferred-reduce queue)")
 
 
 def _nbytes(vals):
     return sum(int(v.size) * _np.dtype(v.dtype).itemsize for v in vals)
+
+
+class _PendingPush(object):
+    """One deferred dist stage-2 entry: the cross-process reduce+apply
+    for a key whose local reduce (stage 1) is already in flight."""
+
+    __slots__ = ("priority", "seq", "key", "upd_key", "box", "shape",
+                 "dtype", "nbytes", "apply_fn")
+
+    def __init__(self, priority, seq, key, upd_key, box, snap0, apply_fn):
+        self.priority = priority
+        self.seq = seq
+        self.key = key
+        self.upd_key = upd_key
+        self.box = box  # filled by stage 1 on a comm worker
+        self.shape = tuple(snap0.shape)
+        self.dtype = _np.dtype(snap0.dtype)
+        self.nbytes = int(snap0.size) * self.dtype.itemsize
+        self.apply_fn = apply_fn
+
+
+class GradBucketer(object):
+    """Deferred-reduce queue for dist stores (tentpole part 2: bucketed,
+    overlapped gradient collectives).
+
+    The reference overlaps communication by making each key's push an
+    engine op with priority=-index; our dist stage 2 additionally rides
+    ONE chain var so every rank issues collectives in identical order —
+    which used to mean strict CALL order, priority ignored. This class
+    restores the priority discipline AND amortizes collective fixed
+    cost: stage-2 entries accumulate here (caller thread, deterministic),
+    and a flush (a) sorts them higher-priority-first, (b) packs them
+    into size-capped same-dtype flat buckets (``MXTPU_BUCKET_BYTES``,
+    default 4 MiB; 0 = one collective per key, the legacy shape), and
+    (c) issues ONE collective per bucket, carving per-key views back out
+    for the updater. Composition happens on the caller's thread from
+    (priority, push order, shapes) alone — all ranks run the same
+    script, so all ranks build identical buckets, preserving the
+    lockstep collective order the chain var enforces.
+
+    Flush triggers: accumulated bytes reach the cap; any pull (the pull
+    must order after its key's deferred update); barrier / updater
+    change / optimizer-state IO (quiescence points)."""
+
+    def __init__(self, bucket_bytes):
+        self.bucket_bytes = bucket_bytes
+        self.pending = []
+        self.pending_bytes = 0
+        self._seq = 0
+
+    def add(self, priority, key, upd_key, box, snap0, apply_fn):
+        self.pending.append(_PendingPush(
+            priority, self._seq, key, upd_key, box, snap0, apply_fn))
+        self._seq += 1
+        self.pending_bytes += self.pending[-1].nbytes
+        return self.pending_bytes >= max(self.bucket_bytes, 1)
+
+    def drain(self):
+        """Priority-ordered (then FIFO) bucket composition; returns a
+        list of same-dtype entry lists, each capped at bucket_bytes."""
+        entries = self.pending
+        self.pending = []
+        self.pending_bytes = 0
+        entries.sort(key=lambda e: (-e.priority, e.seq))
+        buckets = []
+        cur, cur_bytes = [], 0
+        for e in entries:
+            if cur and (cur[0].dtype != e.dtype
+                        or cur_bytes + e.nbytes > self.bucket_bytes):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(e)
+            cur_bytes += e.nbytes
+        if cur:
+            buckets.append(cur)
+        return buckets
 
 
 def _ctype_key_value(keys, vals):
@@ -95,6 +177,7 @@ class KVStore(object):
         self._key_vars = {}  # key -> engine Var (per-key push/pull order)
         self._update_lock = threading.Lock()  # updater/store mutation
         self._dist_chain = None  # lazily: serializes cross-process ops
+        self._bucketer = GradBucketer(_env_bucket_bytes())
         if os.environ.get("MXNET_KVSTORE_ASYNC", "1") == "0":
             self._comm = _engine.NaiveEngine()
         else:
@@ -252,35 +335,91 @@ class KVStore(object):
                     box["error"] = e
                     raise
 
-            def _allreduce_apply(box=box, k=k, upd_key=upd_key,
-                                 snap0=snap[0]):
-                # Deliberately NO retry around this stage: every rank
+            self._comm.push(_local_reduce,
+                            mutable_vars=[self._key_var(k)],
+                            priority=priority, name="reduce:%s" % k)
+            # Stage 2 is DEFERRED into the bucketer (not enqueued yet):
+            # later pushes can coalesce into the same collective, and the
+            # drain order is priority-sorted rather than call-ordered.
+            if self._bucketer.add(priority, k, upd_key, box, snap[0],
+                                  _apply):
+                self._flush_buckets()
+
+    def _flush_buckets(self):
+        """Drain the deferred-reduce queue: enqueue one engine op per
+        coalesced bucket (priority-ordered composition — see
+        GradBucketer). Runs on the caller's thread, so bucket contents
+        and collective order are identical on every rank."""
+        if not self._bucketer.pending:
+            return
+        if self._dist_chain is None:
+            self._dist_chain = self._comm.new_variable()
+        two_phase = os.environ.get("MXTPU_BUCKET_TWO_PHASE", "0") != "0"
+        for entries in self._bucketer.drain():
+
+            def _bucket_allreduce_apply(entries=entries,
+                                        two_phase=two_phase):
+                # Deliberately NO retry around this op: every rank
                 # issues collectives in lockstep on the chain var, and a
                 # rank re-entering an allreduce its peers already left
-                # deadlocks the mesh. Collective failure is process-fatal
-                # by design — recovery is watchdog restart + checkpoint
-                # resume (resilience/checkpoint.py).
+                # deadlocks the mesh. Collective failure is process-
+                # fatal by design — recovery is watchdog restart +
+                # checkpoint resume (resilience/checkpoint.py).
+                import jax
+
                 from .parallel import mesh as _mesh
 
-                if "error" in box:
-                    _mesh.allreduce_sum(
-                        _np.zeros(snap0.shape, dtype=snap0.dtype))
-                    return  # error already recorded by stage 1
                 t0 = time.perf_counter()
-                merged = nd.array(
-                    _mesh.allreduce_sum(box.pop("host")),
-                    ctx=box.pop("ctx"), dtype=box.pop("dtype"))
-                _apply(merged, k, upd_key)
+                dtype = entries[0].dtype
+                sizes = [int(_np.prod(e.shape)) if e.shape else 1
+                         for e in entries]
+                offsets = _np.cumsum([0] + sizes[:-1])
+                flat = _np.zeros(int(sum(sizes)), dtype=dtype)
+                for e, off, n in zip(entries, offsets, sizes):
+                    # a failed stage 1 still contributes (zeros) to the
+                    # collective — peers are already committed to it;
+                    # its error surfaces via raise_pending
+                    if "error" not in e.box:
+                        flat[off:off + n] = e.box.pop("host").ravel()
+                _H_BUCKET_BYTES.observe(flat.nbytes, path="dist")
+                _M_BUCKET_FLUSHES.inc()
+                if two_phase:
+                    # explicit reduce-scatter + all-gather round trip
+                    # (the sharded-update decomposition) instead of one
+                    # allreduce; same bytes on a ring, but keeps the
+                    # whole bucket path on the primitives the fused
+                    # sharded update uses
+                    nproc = jax.process_count()
+                    padded = -(-flat.size // nproc) * nproc
+                    buf = _np.zeros(padded, dtype=dtype)
+                    buf[:flat.size] = flat
+                    shard = _mesh.reduce_scatter_sum(buf)
+                    summed = _mesh.all_gather(shard)[:flat.size]
+                else:
+                    summed = _mesh.allreduce_sum(flat)
+                for e, off, n in zip(entries, offsets, sizes):
+                    if "error" in e.box:
+                        continue
+                    merged = nd.array(
+                        summed[off:off + n].reshape(e.shape),
+                        ctx=e.box.pop("ctx"), dtype=e.box.pop("dtype"))
+                    e.apply_fn(merged, e.key, e.upd_key)
                 _H_ALLREDUCE_SECONDS.observe(time.perf_counter() - t0)
 
-            if self._dist_chain is None:
-                self._dist_chain = self._comm.new_variable()
-            kv_var = self._key_var(k)
-            self._comm.push(_local_reduce, mutable_vars=[kv_var],
-                            priority=priority, name="reduce:%s" % k)
-            self._comm.push(_allreduce_apply,
-                            mutable_vars=[kv_var, self._dist_chain],
-                            priority=priority, name="push:%s" % k)
+            mutable = [self._dist_chain]
+            seen = set()
+            for e in entries:
+                var = self._key_var(e.key)
+                if id(var) not in seen:  # same key pushed twice
+                    seen.add(id(var))
+                    mutable.append(var)
+            name = ("push:%s" % entries[0].key if len(entries) == 1
+                    else "push_bucket:%s" % "+".join(
+                        str(e.key) for e in entries))
+            self._comm.push(_bucket_allreduce_apply,
+                            mutable_vars=mutable,
+                            priority=max(e.priority for e in entries),
+                            name=name)
 
     def pull(self, key, out=None, priority=0):
         """Broadcast stored value to out array(s) (Comm::Broadcast).
@@ -292,6 +431,9 @@ class KVStore(object):
         self._comm.raise_pending()
         if self._heartbeat is not None:
             self._heartbeat.progress()
+        # a pull must order after its key's deferred update: drain the
+        # bucketer BEFORE enqueueing (buckets mix keys, so drain all)
+        self._flush_buckets()
         for k, outs in _ctype_key_value(key, out):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
@@ -351,6 +493,7 @@ class KVStore(object):
 
     # ------------------------------------------------------------------
     def set_updater(self, updater):
+        self._flush_buckets()  # deferred pushes use the old updater
         self._comm.wait_for_all()  # in-flight pushes use the old updater
         self._updater = updater
 
@@ -371,6 +514,7 @@ class KVStore(object):
             clone = copy.copy(optimizer)  # caller's object untouched
             clone.sym = None
             optimizer = pickle.loads(pickle.dumps(clone))
+        self._flush_buckets()
         self._comm.wait_for_all()
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
@@ -396,6 +540,7 @@ class KVStore(object):
         exists to synchronize (round-1/2 finding, fixed)."""
         if self._heartbeat is not None:
             self._heartbeat.progress()
+        self._flush_buckets()  # a barrier implies the queue is drained
         self._comm.wait_for_all()  # a barrier implies local quiescence
         if self._size > 1:
             from .parallel import barrier as _mesh_barrier
@@ -408,6 +553,7 @@ class KVStore(object):
             raise MXNetError("Cannot save states for distributed training")
         from .resilience.checkpoint import atomic_file
 
+        self._flush_buckets()
         self._comm.wait_for_all()  # states must include in-flight updates
         with atomic_file(fname) as fout:
             fout.write(self._updater.get_states())
@@ -415,6 +561,7 @@ class KVStore(object):
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot load states for distributed training")
+        self._flush_buckets()
         self._comm.wait_for_all()
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())

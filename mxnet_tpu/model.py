@@ -67,13 +67,24 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
     """Push grads, pull weights — the server-side-optimizer path
-    (parity model.py:88-97)."""
+    (parity model.py:88-97).
+
+    All pushes issue before any pull: the interleaved push/pull the
+    reference uses would drain the kvstore's deferred-reduce queue
+    (GradBucketer) at every key, capping every bucket at one gradient.
+    Split, the pushes coalesce into size-capped collectives and the
+    first pull flushes them priority-ordered; per-key engine vars keep
+    each pull correctly ordered after its own key's update either way."""
     with _tm.span("model.update_params", path="kvstore"):
+        for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+            _, grad_list = pair
+            if grad_list[0] is None:
+                continue
+            kvstore.push(index, grad_list, priority=-index)
         for index, pair in enumerate(zip(param_arrays, grad_arrays)):
             arg_list, grad_list = pair
             if grad_list[0] is None:
                 continue
-            kvstore.push(index, grad_list, priority=-index)
             kvstore.pull(index, arg_list, priority=-index)
 
 

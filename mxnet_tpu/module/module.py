@@ -557,11 +557,20 @@ class Module(BaseModule):
 
             owner = shared_module._fused_owner or shared_module
             self._fused_owner = owner
+            if owner._fused_trainer.flat_mode is not None:
+                # borrowers update a param-name SUBSET of the owner's
+                # dict; the flat slabs span the owner's full param space
+                # and cannot express that — demote the owner to the
+                # legacy per-param update (state converted in place)
+                owner._fused_opt = owner._fused_trainer.disable_flat_update(
+                    owner._fused_opt)
+                owner._fused_trainer.compile()
             self._fused_trainer = ShardedTrainStep(
                 self._symbol, shared_module._fused_trainer.mesh,
                 optimizer=self._optimizer,
                 param_specs=shared_module._fused_trainer.param_specs,
                 data_names=self._data_names, label_names=self._label_names,
+                flat_update=False,
             ).compile()
         self.optimizer_initialized = True
 
@@ -856,11 +865,19 @@ class Module(BaseModule):
                 return jax.tree_util.tree_map(lambda a: a + 0, tree)
 
             owner = self._fused_owner
+            fused_state = dict(owner._fused_opt)
+            trainer = owner._fused_trainer
+            if trainer.flat_mode is not None:
+                # carve flat bucket slabs back to per-param trees so the
+                # snapshot layout never depends on MXTPU_SHARD_UPDATE /
+                # MXTPU_BUCKET_BYTES (device-side slices: fresh buffers,
+                # still no host pull on the train thread)
+                fused_state = trainer.flat_state_to_named(fused_state)
             return {
                 "arg": _copy(dict(owner._fused_params)),
                 "aux": _copy(dict(owner._fused_aux)),
                 "opt": {"kind": "fused", "t": owner._fused_t,
-                        "state": _copy(dict(owner._fused_opt))},
+                        "state": _copy(fused_state)},
             }
         arg, aux = self.get_params()
         state = {
@@ -873,6 +890,8 @@ class Module(BaseModule):
         if self._kvstore is not None:
             # in-flight async push/pull ops still mutate updater state;
             # quiesce the comm engine so the snapshot is a step boundary
+            # (deferred bucketed reduces included)
+            self._kvstore._flush_buckets()
             self._kvstore._comm.wait_for_all()
         updater = (self._kvstore._updater if self._update_on_kvstore
                    else self._updater)
@@ -914,6 +933,7 @@ class Module(BaseModule):
                     "this module trains on the fused path — resume with "
                     "the same kvstore type it was saved under")
             if self._kvstore is not None:
+                self._kvstore._flush_buckets()
                 self._kvstore._comm.wait_for_all()
             updater = (self._kvstore._updater if self._update_on_kvstore
                        else self._updater)
@@ -926,8 +946,15 @@ class Module(BaseModule):
     def _fused_opt_host_state(self):
         """Fused optimizer state pulled to host: {"t": int, "state":
         {name: nested numpy tuples}} — the on-disk payload shape shared
-        by save_optimizer_states and the checkpoint subsystem."""
+        by save_optimizer_states and the checkpoint subsystem. Always
+        per-param, never flat-bucket slabs: snapshots stay readable
+        whatever MXTPU_SHARD_UPDATE/MXTPU_BUCKET_BYTES said at save
+        time."""
         owner = self._fused_owner
+        state = dict(owner._fused_opt)
+        trainer = owner._fused_trainer
+        if trainer.flat_mode is not None:
+            state = trainer.flat_state_to_named(state)
 
         def _host(s):
             if s is None:
@@ -937,7 +964,7 @@ class Module(BaseModule):
             return np.asarray(s)
 
         return {"t": owner._fused_t,
-                "state": {k: _host(v) for k, v in owner._fused_opt.items()}}
+                "state": {k: _host(v) for k, v in state.items()}}
 
     def _place_fused_opt_state(self, t, state_tree):
         """Place a host optimizer-state tree back onto the fused
@@ -958,9 +985,15 @@ class Module(BaseModule):
             )
 
         owner._fused_t = int(t)
-        owner._fused_opt = {
-            k: _place(k, v) for k, v in state_tree.items()
-        }
+        if trainer.flat_mode is not None:
+            # repack the per-param snapshot into this run's flat bucket
+            # slabs (pads re-zeroed — they provably stay zero under every
+            # elementwise optimizer, so resume is bitwise-exact)
+            owner._fused_opt = trainer.named_state_to_flat(state_tree)
+        else:
+            owner._fused_opt = {
+                k: _place(k, v) for k, v in state_tree.items()
+            }
         if self is not owner:
             self._fused_t = owner._fused_t
             self._fused_opt = owner._fused_opt

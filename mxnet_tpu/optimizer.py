@@ -130,8 +130,32 @@ class Optimizer:
         }
 
     # -- subclass surface ----------------------------------------------
+    # True when update() is a pure elementwise function of
+    # (weight, grad, state) given the scalar hyperparameters from
+    # _fused_kwargs — i.e. element i of every output depends only on
+    # element i of every input. Such optimizers can run on an arbitrary
+    # flat re-layout of the parameter space, which is what the sharded
+    # fused-update path (parallel/train_step.py, MXTPU_SHARD_UPDATE)
+    # exploits: each dp replica updates one contiguous shard of the
+    # flattened params + state. SGLD (per-shape RNG draw) and DCASGD
+    # (create_state captures the live weight values) stay False.
+    elementwise_update = False
+
     def create_state(self, index, weight):
         return None
+
+    def create_state_flat(self, index, size, dtype="float32"):
+        """Shard-aware create_state variant: state for a FLAT view of
+        ``size`` parameter elements (the sharded fused-update path
+        materializes this per dp-shard, so momentum/Adam state exists at
+        1/N of the replicated footprint per device). Default: the
+        regular create_state on a flat zeros weight — valid for every
+        elementwise_update optimizer, whose state init depends only on
+        the weight's shape/dtype."""
+        assert self.elementwise_update, (
+            "%s cannot create flat sharded state (elementwise_update is "
+            "False)" % type(self).__name__)
+        return self.create_state(index, nd.zeros((size,), dtype=dtype))
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
@@ -151,6 +175,8 @@ def _zeros_like_weight(weight, dtype=None):
 @register
 class SGD(Optimizer):
     """SGD with momentum — fused sgd_update/sgd_mom_update kernels."""
+
+    elementwise_update = True
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
@@ -186,6 +212,8 @@ class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference optimizer.py:449):
     half-step SGD plus sqrt(lr) gaussian exploration noise."""
 
+    elementwise_update = False  # RNG draw is keyed by weight shape
+
     def update(self, index, weight, grad, state):
         from . import random as _rnd
 
@@ -203,6 +231,8 @@ class ccSGD(SGD):
 class DCASGD(Optimizer):
     """Delay-compensated async SGD (reference optimizer.py:358): corrects
     stale gradients with lamda * g^2 * (w - w_at_gradient_time)."""
+
+    elementwise_update = False  # create_state snapshots live weights
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
@@ -231,6 +261,8 @@ class DCASGD(Optimizer):
 class Adam(Optimizer):
     """Adam — fused adam_update kernel with bias correction via lr_t."""
 
+    elementwise_update = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -258,6 +290,8 @@ class Adam(Optimizer):
 class AdaGrad(Optimizer):
     """Accumulated squared-gradient scaling (Duchi et al.)."""
 
+    elementwise_update = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -275,6 +309,8 @@ class AdaGrad(Optimizer):
 @register
 class RMSProp(Optimizer):
     """RMSProp (Tieleman/Hinton; Graves when centered) — fused kernels."""
+
+    elementwise_update = True
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
@@ -306,6 +342,8 @@ class RMSProp(Optimizer):
 class AdaDelta(Optimizer):
     """Adadelta (Zeiler): unit-correcting accumulated deltas, no lr."""
 
+    elementwise_update = True
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho = rho
@@ -328,6 +366,8 @@ class AdaDelta(Optimizer):
 @register
 class Ftrl(Optimizer):
     """FTRL-proximal (McMahan et al.) with L1 shrinkage ``lamda1``."""
+
+    elementwise_update = True
 
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -356,6 +396,8 @@ class Ftrl(Optimizer):
 class Test(Optimizer):
     """weight += rescale_grad * grad, mirroring state — the reference's
     dist kvstore nightly-test optimizer."""
+
+    elementwise_update = True
 
     def create_state(self, index, weight):
         return _zeros_like_weight(weight)

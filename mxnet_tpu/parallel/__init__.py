@@ -11,7 +11,8 @@ PlaceDevice pass) becomes PartitionSpec annotations.
 """
 from .mesh import (
     make_mesh, barrier, dp_sharding, replicated_sharding, device_count,
-    init_distributed, allreduce_sum, broadcast_from_root,
+    init_distributed, allreduce_sum, reduce_scatter_sum, all_gather,
+    broadcast_from_root,
 )
 from .train_step import ShardedTrainStep
 from .ring_attention import ring_attention
@@ -21,7 +22,8 @@ from .pipeline import pipeline_stages, pipelined_loss
 __all__ = [
     "make_mesh", "barrier", "dp_sharding", "replicated_sharding",
     "device_count", "ShardedTrainStep", "ring_attention",
-    "init_distributed", "allreduce_sum", "broadcast_from_root",
+    "init_distributed", "allreduce_sum", "reduce_scatter_sum",
+    "all_gather", "broadcast_from_root",
     "switch_moe", "init_moe_params", "moe_partition_specs",
     "pipeline_stages", "pipelined_loss",
 ]

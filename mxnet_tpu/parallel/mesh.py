@@ -120,6 +120,20 @@ def barrier(tag="mxnet-tpu-barrier"):
 
 
 _ALLREDUCE_CACHE = {}
+_REDUCE_SCATTER_CACHE = {}
+_ALL_GATHER_CACHE = {}
+
+
+def _collective_preamble():
+    """Shared guard for explicit host collectives: injected-latency
+    bench knob + fault-injection hook. Collectives are never retried
+    (peers issue them in lockstep), so delay is the only injectable
+    fault — see allreduce_sum for the full rationale."""
+    inj_ms = _injected_latency_ms()  # warns once when the knob is live
+    if inj_ms:
+        _time_mod.sleep(inj_ms / 1000.0)
+    if _fault is not None and _fault.configured():
+        _fault.fire("collective")
 
 
 def allreduce_sum(value):
@@ -148,16 +162,12 @@ def allreduce_sum(value):
     # is the bottleneck — on the 1-core CI box localhost gloo has ~zero
     # latency, so without this the collective chain can never be hidden).
     # The sleep releases the GIL like a real network wait would.
-    inj_ms = _injected_latency_ms()  # warns once when the knob is live
-    if inj_ms:
-        _time_mod.sleep(inj_ms / 1000.0)
-    if _fault is not None and _fault.configured():
-        # MXTPU_FAULT_INJECT delay_collective_ms: the slow/hung-peer
-        # class the watchdog's progress staleness signal must catch.
-        # Collectives are never retried (peers issue them in lockstep;
-        # re-entering one a peer already left deadlocks the mesh), so
-        # delay is the only injectable fault here.
-        _fault.fire("collective")
+    # MXTPU_FAULT_INJECT delay_collective_ms: the slow/hung-peer class
+    # the watchdog's progress staleness signal must catch. Collectives
+    # are never retried (peers issue them in lockstep; re-entering one a
+    # peer already left deadlocks the mesh), so delay is the only
+    # injectable fault here.
+    _collective_preamble()
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -180,6 +190,112 @@ def allreduce_sum(value):
         out = np.asarray(fn(garr).addressable_data(0))
         _H_COLLECTIVE_SECONDS.observe(
             _time_mod.perf_counter() - t0, op="allreduce_sum")
+    return out
+
+
+def reduce_scatter_sum(value):
+    """Sum a host value across ALL processes and return only THIS
+    process's contiguous row-shard of the result.
+
+    The first phase of the sharded weight update (arXiv:2004.13336, the
+    ZeRO-1 pattern): instead of every worker receiving the full summed
+    gradient (allreduce_sum) and redundantly applying the full optimizer
+    update, each worker receives rows ``[rank*R/P, (rank+1)*R/P)`` of the
+    sum, updates only that shard, and publishes it back via
+    :func:`all_gather`. ``value.shape[0]`` must divide evenly by the
+    process count — callers pad (kvstore.GradBucketer rounds flat
+    buckets up). Single-process jobs get the whole sum back, so callers
+    never special-case.
+
+    Same staging scheme as allreduce_sum (value rides local row 0, other
+    local device rows are zeros, XLA sums over the process-spanning
+    device axis), but the output stays sharded over that axis so each
+    process only reads back its own rows — the readback is O(N/P)
+    instead of O(N)."""
+    import jax
+
+    value = np.asarray(value)
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return value
+    assert value.ndim >= 1 and value.shape[0] % nproc == 0, (
+        "reduce_scatter_sum: leading dim %r not divisible by %d processes"
+        % (value.shape, nproc))
+    _collective_preamble()
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    nloc = jax.local_device_count()
+    key = (value.shape, value.dtype.str, nloc)
+    if key not in _REDUCE_SCATTER_CACHE:
+        mesh = Mesh(np.asarray(jax.devices()).reshape(nproc, nloc),
+                    ("proc", "loc"))
+        in_sharding = NamedSharding(mesh, P(("proc", "loc")))
+        # sum over the staging axis; keep the result row-sharded over
+        # processes so each one materializes only its own rows
+        out_sharding = NamedSharding(mesh, P("proc"))
+        fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                     out_shardings=out_sharding)
+        _REDUCE_SCATTER_CACHE[key] = (in_sharding, fn)
+    in_sharding, fn = _REDUCE_SCATTER_CACHE[key]
+    with _tm.span("mesh.reduce_scatter_sum", nbytes=value.nbytes):
+        t0 = _time_mod.perf_counter()
+        local = np.zeros((nloc,) + value.shape, value.dtype)
+        local[0] = value
+        garr = jax.make_array_from_process_local_data(in_sharding, local)
+        out = fn(garr)
+        # result is sharded over "proc" and replicated over "loc": every
+        # local device holds this process's full row-shard — read one
+        mine = np.asarray(out.addressable_shards[0].data)
+        _H_COLLECTIVE_SECONDS.observe(
+            _time_mod.perf_counter() - t0, op="reduce_scatter_sum")
+    return mine
+
+
+def all_gather(value):
+    """Concatenate equal-shaped per-process shards along axis 0; every
+    process receives the full result (inverse of reduce_scatter_sum —
+    the publish phase of the sharded weight update: each worker
+    contributes its updated weight shard, all receive the full vector).
+
+    Single-process jobs return the value unchanged."""
+    import jax
+
+    value = np.asarray(value)
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return value
+    _collective_preamble()
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    nloc = jax.local_device_count()
+    key = (value.shape, value.dtype.str, nloc)
+    if key not in _ALL_GATHER_CACHE:
+        mesh = Mesh(np.asarray(jax.devices()).reshape(nproc, nloc),
+                    ("proc", "loc"))
+        in_sharding = NamedSharding(mesh, P(("proc", "loc")))
+        out_sharding = NamedSharding(mesh, P())
+        # local rows beyond row 0 are zeros; summing within each
+        # process's block recovers that process's contribution exactly,
+        # then blocks concatenate in process order
+        def _gather(x):
+            blocks = x.reshape((nproc, nloc) + value.shape)
+            per_proc = jnp.sum(blocks, axis=1)  # (nproc,) + value.shape
+            return per_proc.reshape((nproc * value.shape[0],)
+                                    + value.shape[1:])
+
+        fn = jax.jit(_gather, out_shardings=out_sharding)
+        _ALL_GATHER_CACHE[key] = (in_sharding, fn)
+    in_sharding, fn = _ALL_GATHER_CACHE[key]
+    with _tm.span("mesh.all_gather", nbytes=value.nbytes):
+        t0 = _time_mod.perf_counter()
+        local = np.zeros((nloc,) + value.shape, value.dtype)
+        local[0] = value
+        garr = jax.make_array_from_process_local_data(in_sharding, local)
+        out = np.asarray(fn(garr).addressable_data(0))
+        _H_COLLECTIVE_SECONDS.observe(
+            _time_mod.perf_counter() - t0, op="all_gather")
     return out
 
 

@@ -25,6 +25,10 @@ traced scalars so one compilation serves every step.
 """
 from __future__ import annotations
 
+import contextlib
+import logging
+import os
+
 import numpy as np
 
 from .. import telemetry as _tm
@@ -32,6 +36,85 @@ from .. import telemetry as _tm
 _M_STEPS = _tm.counter(
     "train_step.steps", "Optimizer steps dispatched through the fused "
     "ShardedTrainStep path")
+_M_FLAT_BUCKETS = _tm.counter(
+    "train_step.flat_buckets", "Flat update buckets planned by the "
+    "sharded/bucketed fused-update path (one count per bucket per plan)")
+_H_BUCKET_BYTES = _tm.histogram(
+    "kvstore.bucket_bytes", "Payload bytes per coalesced gradient bucket "
+    "(kvstore GradBucketer flushes and fused flat-update plan buckets)")
+
+from ..base import bucket_bytes_env as _env_bucket_bytes  # noqa: E402
+
+
+class _FlatBucket:
+    """One size-capped flat slab of the parameter space: contiguous
+    per-key views carved out of a single (padded) 1-D buffer, all
+    sharing one (dtype, lr_mult, wd_mult) signature so a single set of
+    fused-optimizer scalar kwargs is valid for the whole slab."""
+
+    __slots__ = ("rep_index", "dtype", "views", "size", "padded")
+
+    def __init__(self, rep_index, dtype, views, dp):
+        self.rep_index = rep_index  # index whose _fused_kwargs apply
+        self.dtype = dtype
+        self.views = views  # [(index, name, offset, size, shape)]
+        self.size = sum(v[3] for v in views)
+        # pad so the slab splits evenly into dp contiguous shards
+        self.padded = -(-self.size // dp) * dp
+
+
+class _FlatUpdatePlan:
+    """Bucketing layout for the flat fused update (tentpole part 2/3).
+
+    Groups params by (dtype, lr_mult, wd_mult), walks each group in
+    REVERSE key order (backward produces late keys' gradients first, so
+    their buckets' collectives can fly while earlier layers are still
+    differentiating), and packs size-capped buckets."""
+
+    def __init__(self, param_names, shapes, dtypes, optimizer, dp,
+                 bucket_bytes):
+        groups = {}
+        order = []
+        for i, name in enumerate(param_names):
+            key = (dtypes[name],
+                   optimizer._mult_for(i, optimizer.lr_mult),
+                   optimizer._mult_for(i, optimizer.wd_mult))
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append((i, name))
+        self.buckets = []
+        for key in order:
+            dtype = key[0]
+            itemsize = np.dtype(dtype).itemsize
+            cap = max(1, bucket_bytes // itemsize)
+            pending = []
+            pending_elems = 0
+            for i, name in reversed(groups[key]):
+                size = int(np.prod(shapes[name])) if shapes[name] else 1
+                if pending and pending_elems + size > cap:
+                    self._close(pending, dtype, dp)
+                    pending, pending_elems = [], 0
+                pending.append((i, name, size, shapes[name]))
+                pending_elems += size
+            if pending:
+                self._close(pending, dtype, dp)
+        self.by_name = {}
+        for bi, b in enumerate(self.buckets):
+            for (i, name, off, size, shape) in b.views:
+                self.by_name[name] = (bi, off, size, shape)
+        for b in self.buckets:
+            _M_FLAT_BUCKETS.inc()
+            _H_BUCKET_BYTES.observe(
+                b.size * np.dtype(b.dtype).itemsize, path="flat_update")
+
+    def _close(self, pending, dtype, dp):
+        views = []
+        off = 0
+        for (i, name, size, shape) in pending:
+            views.append((i, name, off, size, shape))
+            off += size
+        self.buckets.append(_FlatBucket(pending[0][0], dtype, views, dp))
 
 
 class _EveryKeyCount(dict):
@@ -82,7 +165,7 @@ class ShardedTrainStep:
 
     def __init__(self, symbol, mesh, optimizer=None, param_specs=None,
                  data_names=("data",), label_names=("softmax_label",),
-                 dtype=None, zero1=False):
+                 dtype=None, zero1=False, flat_update=None):
         from jax.sharding import PartitionSpec as P
 
         from ..executor import _GraphProgram
@@ -114,6 +197,46 @@ class ShardedTrainStep:
             (not n.is_variable) and n.op.needs_rng
             for n in self.program.nodes
         )
+        # -- flat bucketed/sharded update (arXiv:2004.13336) ------------
+        # flat_mode: None = legacy per-param update;
+        # "shard" = each dp replica updates its contiguous 1/N shard of
+        #   the flat param+state space inside shard_map, state is
+        #   materialized sharded (1/N per device), updated weights are
+        #   all-gathered in-step;
+        # "replicated" = identical flat layout and identical shard-width
+        #   update body, but run on every replica via a scan over the dp
+        #   chunks with full-size state — the bitwise-matched baseline
+        #   the sharded mode is tested against (same chunk width ⇒ same
+        #   XLA elementwise codegen; full-width codegen may contract
+        #   mul+add into FMA differently, which is why the baseline is
+        #   chunk-matched rather than the monolithic legacy update).
+        self.flat_bucket_bytes = _env_bucket_bytes()
+        dp = mesh.shape.get("dp", 1)
+        non_dp = 1
+        for ax, n in mesh.shape.items():
+            if ax != "dp":
+                non_dp *= n
+        eligible = (
+            optimizer is not None
+            and getattr(optimizer, "elementwise_update", False)
+            and dp > 1
+            and non_dp == 1
+            and not self.param_specs
+            and not zero1  # explicit ZeRO-1 request → legacy layout
+            and self.flat_bucket_bytes > 0
+        )
+        if flat_update is False or not eligible:
+            self.flat_mode = None
+        else:
+            self.flat_mode = (
+                "shard"
+                if os.environ.get("MXTPU_SHARD_UPDATE", "1") != "0"
+                else "replicated")
+            logging.getLogger(__name__).info(
+                "fused update path: flat bucketed (%s, dp=%d, "
+                "MXTPU_BUCKET_BYTES=%d)", self.flat_mode, dp,
+                self.flat_bucket_bytes)
+        self._flat_plan = None  # built lazily from placed param shapes
 
     # ------------------------------------------------------------------
     def _spec_for(self, name):
@@ -135,6 +258,113 @@ class ShardedTrainStep:
                 and arr.shape[0] % self.mesh.shape["dp"] == 0):
             spec = P("dp")
         return NamedSharding(self.mesh, spec)
+
+    # -- flat bucketed/sharded update layer -----------------------------
+    @staticmethod
+    def _flat_key(bucket_index):
+        """Opt-state dict key of one flat bucket's state slab (the dict
+        otherwise maps param name -> state; flat slabs span params)."""
+        return "__flat__%d" % bucket_index
+
+    def _ensure_flat_plan(self, params):
+        if self._flat_plan is None:
+            shapes = {n: tuple(params[n].shape) for n in self.param_names}
+            dtypes = {n: str(params[n].dtype) for n in self.param_names}
+            self._flat_plan = _FlatUpdatePlan(
+                self.param_names, shapes, dtypes, self.optimizer,
+                self.mesh.shape["dp"], self.flat_bucket_bytes)
+        return self._flat_plan
+
+    def _flat_state_sharding(self):
+        """State-slab sharding: each dp replica materializes only its
+        contiguous 1/N shard in "shard" mode; the "replicated" baseline
+        keeps full slabs everywhere (that redundancy is what the sharded
+        mode removes)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P("dp") if self.flat_mode == "shard" else P()
+        return NamedSharding(self.mesh, spec)
+
+    def flat_state_to_named(self, opt_state):
+        """Carve the flat state slabs back into the per-param nested
+        trees the legacy layout uses (lazy device-side slices; callers
+        numpy-ify off-thread). Checkpoints and save_optimizer_states
+        always store THIS layout, so snapshots are layout-independent:
+        a run with sharding on resumes with it off and vice versa."""
+        plan = self._flat_plan
+        assert plan is not None, "flat plan not built yet"
+
+        def _slice(st, off, size, shape):
+            if st is None:
+                return None
+            if isinstance(st, tuple):
+                return tuple(_slice(s, off, size, shape) for s in st)
+            return st[off:off + size].reshape(shape)
+
+        named = {}
+        for bi, b in enumerate(plan.buckets):
+            st = opt_state.get(self._flat_key(bi))
+            for (_i, name, off, size, shape) in b.views:
+                named[name] = _slice(st, off, size, shape)
+        return named
+
+    def named_state_to_flat(self, named):
+        """Inverse of flat_state_to_named: pack per-param (host) state
+        trees into device-placed flat slabs, zero-padding each slab to a
+        dp multiple (pad lanes stay exactly zero under every
+        elementwise_update optimizer, so they never leak into views)."""
+        import jax
+
+        plan = self._flat_plan
+        assert plan is not None, "flat plan not built yet"
+        sharding = self._flat_state_sharding()
+
+        def _pack(parts, pad, dtype):
+            if all(p is None for p in parts):
+                return None
+            if isinstance(parts[0], tuple):
+                return tuple(
+                    _pack([p[j] for p in parts], pad, dtype)
+                    for j in range(len(parts[0])))
+            flats = [np.asarray(p).reshape(-1) for p in parts]
+            leaf_dtype = flats[0].dtype
+            if pad:
+                flats.append(np.zeros((pad,), leaf_dtype))
+            return jax.device_put(np.concatenate(flats), sharding)
+
+        state = {}
+        for bi, b in enumerate(plan.buckets):
+            parts = [named[name] for (_i, name, _o, _s, _sh) in b.views]
+            state[self._flat_key(bi)] = _pack(
+                parts, b.padded - b.size, b.dtype)
+        return state
+
+    def disable_flat_update(self, opt_state):
+        """Demote to the legacy per-param update (borrow_optimizer /
+        BucketingModule: borrowers share a param-name SUBSET, which the
+        flat slabs cannot express). Converts the flat state back to
+        per-name placement and invalidates compiled steps; returns the
+        converted opt_state dict."""
+        if self.flat_mode is None:
+            return opt_state
+        import jax
+
+        named = self.flat_state_to_named(opt_state)
+
+        def _place(name, s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(_place(name, x) for x in s)
+            host = np.asarray(s)
+            return jax.device_put(host,
+                                  self._state_sharding_for(name, host))
+
+        placed = {n: _place(n, s) for n, s in named.items()}
+        self.flat_mode = None
+        self._step = None
+        self._step_multi = {}
+        return placed
 
     def batch_sharding(self):
         from jax.sharding import NamedSharding
@@ -178,6 +408,25 @@ class ShardedTrainStep:
 
         if self.optimizer is None:
             return {}
+        if self.flat_mode is not None:
+            plan = self._ensure_flat_plan(params)
+            sharding = self._flat_state_sharding()
+            state = {}
+            for bi, b in enumerate(plan.buckets):
+                st = self.optimizer.create_state_flat(
+                    b.rep_index, b.padded, dtype=b.dtype)
+
+                def _place_flat(s):
+                    if s is None:
+                        return None
+                    if isinstance(s, tuple):
+                        return tuple(_place_flat(x) for x in s)
+                    return jax.device_put(s.asnumpy(), sharding)
+
+                placed = _place_flat(st)
+                if placed is not None:
+                    state[self._flat_key(bi)] = placed
+            return state
         state = {}
         for i, name in enumerate(self.param_names):
             p = params[name]
@@ -232,23 +481,14 @@ class ShardedTrainStep:
         return params, aux, opt_state
 
     # ------------------------------------------------------------------
-    def _apply_optimizer(self, params, grads, opt_state, lr, t):
-        """Trace through Optimizer.update for every param.
-
-        Patches the instance's step-dependent attributes with traced
-        stand-ins for the duration of the trace (this method only runs
-        at trace time), so the SAME compiled program is valid for every
-        step: lr comes from the host scheduler each call, t drives
-        Adam-style bias correction in-graph."""
-        from ..ndarray import NDArray
-
+    @contextlib.contextmanager
+    def _patched_optimizer(self, lr, t):
+        """Patch the optimizer's step-dependent attributes with traced
+        stand-ins for the duration of a trace (only runs at trace time),
+        so the SAME compiled program is valid for every step: lr comes
+        from the host scheduler each call, t drives Adam-style bias
+        correction in-graph."""
         opt = self.optimizer
-        new_params, new_state = {}, {}
-        if opt is None:
-            for name in self.param_names:
-                new_params[name] = params[name] - lr * grads[name]
-            return new_params, new_state
-
         saved_lr = opt.lr
         saved_sched = opt.lr_scheduler
         saved_counts = opt._index_update_count
@@ -258,6 +498,28 @@ class ShardedTrainStep:
         opt._index_update_count = _EveryKeyCount(t)
         opt._update_count = lambda index: None  # instance shadow
         try:
+            yield opt
+        finally:
+            del opt.__dict__["_update_count"]
+            opt.lr = saved_lr
+            opt.lr_scheduler = saved_sched
+            opt._index_update_count = saved_counts
+            opt.num_update = saved_num_update
+
+    def _apply_optimizer(self, params, grads, opt_state, lr, t):
+        """Trace through Optimizer.update for every param (legacy
+        per-key layout; see _apply_optimizer_flat for the bucketed
+        path)."""
+        from ..ndarray import NDArray
+
+        opt = self.optimizer
+        new_params, new_state = {}, {}
+        if opt is None:
+            for name in self.param_names:
+                new_params[name] = params[name] - lr * grads[name]
+            return new_params, new_state
+
+        with self._patched_optimizer(lr, t):
             for i, name in enumerate(self.param_names):
                 w = NDArray(params[name])
                 g = NDArray(grads[name])
@@ -275,12 +537,132 @@ class ShardedTrainStep:
             for name in opt_state:
                 if name not in new_state:
                     new_state[name] = opt_state[name]
-        finally:
-            del opt.__dict__["_update_count"]
-            opt.lr = saved_lr
-            opt.lr_scheduler = saved_sched
-            opt._index_update_count = saved_counts
-            opt.num_update = saved_num_update
+        return new_params, new_state
+
+    def _flat_body(self, bucket, w_c, g_c, st_c, lr, t):
+        """One optimizer step on a width-S chunk of a flat bucket.
+
+        Shared verbatim by BOTH flat modes: in "shard" mode it is the
+        shard_map per-device body (S = padded/dp); in "replicated" mode
+        the lax.scan body walks the same dp chunks of width S. Chunk
+        widths matching is what makes the two modes bitwise-equal — XLA
+        contracts mul+add into FMA per fusion width, so a full-width
+        replicated update would round differently than the sharded one.
+        The optimizer attrs are re-pointed at THIS scope's tracers so
+        shard_map never closes over outer-scope values."""
+        from ..ndarray import NDArray
+
+        opt = self.optimizer
+        opt.lr = lr
+        opt._index_update_count = _EveryKeyCount(t)
+        w = NDArray(w_c)
+        g = NDArray(g_c)
+        st = _wrap_state(st_c, NDArray)
+        opt.update(bucket.rep_index, w, g, st)
+        return w._data, _unwrap_state(st) if st is not None else None
+
+    def _apply_optimizer_flat(self, params, grads, opt_state, lr, t):
+        """Bucketed flat update: concat params/grads per bucket, run the
+        optimizer on dp-wide chunks, carve per-key views back out.
+
+        "shard" mode (MXTPU_SHARD_UPDATE=1, the default): the update
+        runs inside shard_map — each replica updates only its contiguous
+        1/N shard of the flat space against its reduce-scattered slice
+        of the (GSPMD-allreduced) gradient, state stays sharded P("dp"),
+        and updated weights are all-gathered back to replicated. The
+        arXiv:2004.13336 recipe: O(params/N) update flops + state bytes.
+
+        "replicated" mode: identical math via lax.scan over the same dp
+        chunks on every replica — the bitwise parity baseline."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        opt = self.optimizer
+        if opt is None or self.flat_mode is None:
+            return self._apply_optimizer(params, grads, opt_state, lr, t)
+
+        plan = self._ensure_flat_plan(params)
+        dp = self.mesh.shape["dp"]
+        new_params, new_state = {}, {}
+        with self._patched_optimizer(lr, t):
+            for bi, b in enumerate(plan.buckets):
+                pad = b.padded - b.size
+                w_parts = [params[name].reshape(-1)
+                           for (_i, name, _o, _s, _sh) in b.views]
+                g_parts = [grads[name].reshape(-1)
+                           for (_i, name, _o, _s, _sh) in b.views]
+                if pad:
+                    zpad = jnp.zeros((pad,), w_parts[0].dtype)
+                    w_parts.append(zpad)
+                    g_parts.append(zpad)
+                flat_w = jnp.concatenate(w_parts)
+                flat_g = jnp.concatenate(g_parts)
+                # hard fusion boundary: materialize the flat buffers in
+                # BOTH modes so XLA cannot FMA-contract the gradient
+                # chain into the update kernel differently per mode —
+                # bitwise parity depends on both modes consuming the
+                # same materialized values at the same chunk width
+                flat_w, flat_g = jax.lax.optimization_barrier(
+                    (flat_w, flat_g))
+                # keep the weight concat replicated too: otherwise GSPMD
+                # builds the flat buffer sharded and re-assembles it
+                # with an extra full-size all-reduce (CPU partitioner).
+                # The GRADIENT concat is left alone — constraining it
+                # perturbs sharding propagation through the backward
+                # graph enough to change reduction orders, which breaks
+                # the bitwise shard↔replicated parity.
+                rep = NamedSharding(self.mesh, P())
+                flat_w = jax.lax.with_sharding_constraint(flat_w, rep)
+                st = opt_state.get(self._flat_key(bi))
+
+                if self.flat_mode == "shard":
+                    from jax.experimental.shard_map import shard_map
+
+                    def body(w_c, g_c, st_c, lr_c, t_c, _b=b):
+                        nw, nst = self._flat_body(_b, w_c, g_c, st_c,
+                                                  lr_c, t_c)
+                        # weights rejoin the replicated dispatch plan;
+                        # state stays resident on its owning shard
+                        nw_full = jax.lax.all_gather(
+                            nw, "dp", tiled=True)
+                        return nw_full, nst
+
+                    flat_nw, nst = shard_map(
+                        body, mesh=self.mesh,
+                        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()),
+                        out_specs=(P(), P("dp")),
+                        check_rep=False,
+                    )(flat_w, flat_g, st, lr, t)
+                else:
+                    S = b.padded // dp
+
+                    def scan_body(carry, xs, _b=b):
+                        w_c, g_c, st_c = xs
+                        return carry, self._flat_body(_b, w_c, g_c,
+                                                      st_c, lr, t)
+
+                    w2 = flat_w.reshape(dp, S)
+                    g2 = flat_g.reshape(dp, S)
+                    st2 = jax.tree_util.tree_map(
+                        lambda a: a.reshape(dp, S), st)
+                    _, (nw2, nst2) = jax.lax.scan(
+                        scan_body, 0, (w2, g2, st2))
+                    flat_nw = nw2.reshape(b.padded)
+                    nst = jax.tree_util.tree_map(
+                        lambda a: a.reshape(b.padded), nst2)
+
+                for (_i, name, off, size, shape) in b.views:
+                    new_params[name] = (
+                        flat_nw[off:off + size].reshape(shape))
+                if nst is not None:
+                    new_state[self._flat_key(bi)] = nst
+        for name in params:
+            if name not in new_params:
+                new_params[name] = params[name]
+        for k in opt_state:
+            if k not in new_state:
+                new_state[k] = opt_state[k]
         return new_params, new_state
 
     def _make_step_fn(self):
@@ -311,9 +693,26 @@ class ShardedTrainStep:
             grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
             # gradient allreduce over dp happens implicitly: params are
             # replicated, batch is dp-sharded → GSPMD inserts psum here.
-            new_params, new_opt = self._apply_optimizer(
-                params, grads, opt_state, lr, t
-            )
+            # (In flat "shard" mode the P("dp") in_specs then slice that
+            # allreduced gradient per replica — allreduce+slice is XLA's
+            # canonical reduce-scatter decomposition, which its collective
+            # combiner re-forms into reduce-scatter on TPU.)
+            if self.flat_mode is not None:
+                # pin grads replicated at the source, IDENTICALLY in both
+                # flat modes: without this GSPMD shards the downstream
+                # flat concat and re-assembles it with an extra full-size
+                # all-reduce per flat buffer (CPU partitioner), and any
+                # mode-asymmetric resharding of the backward graph would
+                # break the bitwise shard↔replicated parity
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                rep = NamedSharding(self.mesh, P())
+                grads = {k: jax.lax.with_sharding_constraint(g, rep)
+                         for k, g in grads.items()}
+            apply = (self._apply_optimizer_flat
+                     if self.flat_mode is not None
+                     else self._apply_optimizer)
+            new_params, new_opt = apply(params, grads, opt_state, lr, t)
             new_aux = {**aux, **new_aux}  # carry shared-owner extras through
             return new_params, new_aux, new_opt, outs
 

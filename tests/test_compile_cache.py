@@ -1,0 +1,64 @@
+"""MXTPU_COMPILE_CACHE: persistent XLA compilation cache wiring.
+
+base._init_compile_cache() runs at import and points JAX's persistent
+compilation cache at the given directory with the size/time thresholds
+dropped to 0 (our programs are many small jit bodies). Verified in a
+subprocess because the knob must be set before any compilation.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_PROBE = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import mxnet_tpu  # triggers _init_compile_cache()
+import jax, jax.numpy as jnp
+
+cfg_dir = jax.config.jax_compilation_cache_dir
+out = jax.jit(lambda x: x * 2.0 + 1.0)(jnp.arange(8, dtype=jnp.float32))
+out.block_until_ready()
+cache_dir = os.environ["MXTPU_COMPILE_CACHE"]
+entries = []
+for root, _, files in os.walk(cache_dir):
+    entries.extend(files)
+print(json.dumps({"cfg_dir": cfg_dir, "entries": entries}))
+"""
+
+
+def _run_probe(env):
+    full_env = dict(os.environ)
+    full_env.update(env)
+    full_env.pop("XLA_FLAGS", None)  # single device is fine here
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE], env=full_env,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_compile_cache_populates_dir(tmp_path):
+    cache = tmp_path / "xla_cache"
+    cache.mkdir()
+    res = _run_probe({"MXTPU_COMPILE_CACHE": str(cache),
+                      "PYTHONPATH": os.path.dirname(
+                          os.path.dirname(os.path.abspath(__file__)))})
+    assert res["cfg_dir"] == str(cache)
+    if not res["entries"]:  # some jax builds can't cache CPU executables
+        pytest.skip("jax persistent cache wrote no CPU entries here")
+    assert res["entries"]
+
+
+def test_compile_cache_off_by_default():
+    from mxnet_tpu import base
+
+    env_backup = os.environ.pop("MXTPU_COMPILE_CACHE", None)
+    try:
+        # no env -> no-op, must not raise or touch jax config
+        base._init_compile_cache()
+    finally:
+        if env_backup is not None:
+            os.environ["MXTPU_COMPILE_CACHE"] = env_backup

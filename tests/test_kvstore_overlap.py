@@ -192,3 +192,159 @@ def test_executor_forward_drains_pending_pull():
     exe.arg_dict["data"][:] = np.ones((1, 3))
     out = exe.forward(is_train=False)
     np.testing.assert_allclose(out[0].asnumpy(), [[15.0]], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# GradBucketer: deferred stage-2 coalescing for dist stores (ISSUE 5).
+# Single-process fake-dist: kv.type/_size flip the store onto the dist
+# push path while jax collectives pass values through unchanged.
+# ---------------------------------------------------------------------------
+
+def _fake_dist_kv(bucket_bytes=None):
+    kv = _fresh_kv()
+    kv.type = "dist_sync"
+    kv._size = 2
+    if bucket_bytes is not None:
+        kv._bucketer.bucket_bytes = bucket_bytes
+    return kv
+
+
+def test_bucketer_coalesces_and_defers():
+    """Pushes below the byte cap stay pending (no stage-2 op enqueued);
+    the flush issues ONE coalesced collective whose name lists every
+    key, and values land correctly."""
+    kv = _fake_dist_kv()  # default 4 MiB cap: tiny grads all defer
+    for k in range(3):
+        kv.init(k, mx.nd.zeros((4,)))
+    kv._comm.wait_for_all()
+    trace = kv._comm.start_trace()
+    for k in range(3):
+        kv.push(k, mx.nd.ones((4,)) * (k + 1), priority=-k)
+    assert len(kv._bucketer.pending) == 3  # deferred, not enqueued
+    kv._flush_buckets()
+    assert not kv._bucketer.pending
+    kv._comm.wait_for_all()
+    names = [r["name"] for r in kv._comm.stop_trace()
+             if r["name"] and r["name"].startswith("push")]
+    assert names == ["push_bucket:0+1+2"], names
+    for k in range(3):
+        out = mx.nd.zeros((4,))
+        kv.pull(k, out=out)
+        np.testing.assert_allclose(out.asnumpy(), (k + 1) * np.ones(4))
+
+
+def test_bucketer_priority_orders_drain():
+    """Drain composes buckets higher-priority-first regardless of push
+    call order: the coalesced op's key list is priority-sorted, so every
+    rank issues the identical collective."""
+    kv = _fake_dist_kv()
+    for k in range(3):
+        kv.init(k, mx.nd.zeros((4,)))
+    kv._comm.wait_for_all()
+    trace = kv._comm.start_trace()
+    # reverse call order with the -param_index convention
+    for k in reversed(range(3)):
+        kv.push(k, mx.nd.ones((4,)), priority=-k)
+    kv._flush_buckets()
+    kv._comm.wait_for_all()
+    names = [r["name"] for r in kv._comm.stop_trace()
+             if r["name"] and r["name"].startswith("push")]
+    assert names == ["push_bucket:0+1+2"], names  # NOT 2+1+0
+
+
+def test_bucketer_byte_cap_triggers_flush():
+    """Crossing the cap flushes immediately; size-capped packing splits
+    entries into multiple collectives."""
+    kv = _fake_dist_kv(bucket_bytes=32)  # 8 float32s
+    for k in range(2):
+        kv.init(k, mx.nd.zeros((6,)))  # 24 bytes each
+    kv._comm.wait_for_all()
+    trace = kv._comm.start_trace()
+    kv.push(0, mx.nd.ones((6,)), priority=0)
+    assert len(kv._bucketer.pending) == 1  # 24 < 32: still pending
+    kv.push(1, mx.nd.ones((6,)) * 2, priority=-1)
+    assert not kv._bucketer.pending  # 48 >= 32: auto-flushed
+    kv._comm.wait_for_all()
+    names = [r["name"] for r in kv._comm.stop_trace()
+             if r["name"] and r["name"].startswith("push")]
+    # 24 + 24 > 32: the two entries cannot share a bucket
+    assert names == ["push:0", "push:1"], names
+    out = mx.nd.zeros((6,))
+    kv.pull(1, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2 * np.ones(6))
+
+
+def test_bucket_bytes_zero_legacy_per_key():
+    """bucket_bytes=0 is the legacy shape: every push flushes its own
+    singleton collective immediately (one op per key, call order)."""
+    kv = _fake_dist_kv(bucket_bytes=0)
+    for k in range(3):
+        kv.init(k, mx.nd.zeros((2,)))
+    kv._comm.wait_for_all()
+    trace = kv._comm.start_trace()
+    for k in range(3):
+        kv.push(k, mx.nd.ones((2,)), priority=-k)
+        assert not kv._bucketer.pending
+    kv._comm.wait_for_all()
+    names = [r["name"] for r in kv._comm.stop_trace()
+             if r["name"] and r["name"].startswith("push")]
+    assert names == ["push:0", "push:1", "push:2"], names
+
+
+def test_bucketer_dtype_split():
+    """Mixed dtypes cannot share a flat slab: drain opens a new bucket
+    at every dtype boundary (after priority sort)."""
+    kv = _fake_dist_kv()
+    kv.init("a", mx.nd.zeros((4,), dtype="float64"))
+    kv.init("b", mx.nd.zeros((4,)))
+    kv._comm.wait_for_all()
+    trace = kv._comm.start_trace()
+    kv.push("a", mx.nd.ones((4,), dtype="float64"), priority=-5)
+    kv.push("b", mx.nd.ones((4,)), priority=0)
+    kv._flush_buckets()
+    kv._comm.wait_for_all()
+    names = [r["name"] for r in kv._comm.stop_trace()
+             if r["name"] and r["name"].startswith("push")]
+    # priority puts b first, dtype forces a's own bucket
+    assert names == ["push:b", "push:a"], names
+
+
+def test_pull_flushes_pending_bucket():
+    """pull() must drain the deferred queue first — otherwise it would
+    read a weight whose update is still parked in the bucketer."""
+    kv = _fake_dist_kv()
+    kv.init(0, mx.nd.zeros((4,)))
+    kv.push(0, mx.nd.ones((4,)) * 7)
+    assert kv._bucketer.pending  # deferred
+    out = mx.nd.zeros((4,))
+    kv.pull(0, out=out)  # implicit flush
+    np.testing.assert_allclose(out.asnumpy(), 7 * np.ones(4))
+
+
+def test_bucket_flush_observes_telemetry():
+    """Each bucket flush records its flat payload size in the
+    kvstore.bucket_bytes histogram (the trace_summary input)."""
+    from mxnet_tpu import telemetry as tm
+
+    was = tm.enabled()
+    tm.enable()
+    try:
+        kv = _fake_dist_kv()
+        for k in range(2):
+            kv.init(k, mx.nd.zeros((4,)))
+        before = tm.snapshot().get("kvstore.bucket_bytes", {})
+        b_count = sum(s["count"] for s in before.get("streams", []))
+        for k in range(2):
+            kv.push(k, mx.nd.ones((4,)), priority=-k)
+        kv._flush_buckets()
+        kv._comm.wait_for_all()
+        after = tm.snapshot()["kvstore.bucket_bytes"]
+        dist = [s for s in after["streams"]
+                if s["labels"].get("path") == "dist"]
+        assert dist and sum(s["count"] for s in after["streams"]) > b_count
+        # one coalesced flush of 2 * 4 float32s = 32 bytes
+        assert any(abs(s["sum"] - 32.0) < 1e-9 or s["sum"] >= 32.0
+                   for s in dist)
+    finally:
+        if not was:
+            tm.disable()

@@ -146,11 +146,17 @@ def test_transformer_forward():
     assert np.isfinite(np.asarray(logits)).all()
 
 
-def test_zero1_opt_state_sharding_matches_replicated():
+def test_zero1_opt_state_sharding_matches_replicated(monkeypatch):
     """ZeRO-1 (train_step.py opt-state dp-sharding; PAPERS.md 'Automatic
     Cross-Replica Sharding of Weight Update'): layout changes, numerics
     must not. Trains the same net with and without zero1 and compares
-    params exactly; also asserts the momentum state really is dp-sharded."""
+    params exactly; also asserts the momentum state really is dp-sharded.
+
+    Pins MXTPU_BUCKET_BYTES=0 so both runs take the legacy per-param
+    update whose layout this test asserts (the flat bucketed path that
+    is now the dp>1 default has its own parity suite,
+    tests/test_sharded_update.py)."""
+    monkeypatch.setenv("MXTPU_BUCKET_BYTES", "0")
     import jax
     from jax.sharding import PartitionSpec as P
 

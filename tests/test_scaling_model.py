@@ -38,6 +38,22 @@ def test_dp_step_allreduce_bytes_match_param_bytes():
     parameter — the property the ResNet-50 accounting relies on."""
     from mxnet_tpu.parallel import ShardedTrainStep, make_mesh
 
+    # the scaling model accounts for the per-key schedule; pin it (the
+    # default flat bucketed/sharded update coalesces gradients and adds
+    # a weight all-gather — its accounting lives in benchmarks/
+    # sharded_ab.py and tests/test_sharded_update.py)
+    prev = os.environ.get("MXTPU_BUCKET_BYTES")
+    os.environ["MXTPU_BUCKET_BYTES"] = "0"
+    try:
+        _dp_step_allreduce_check(ShardedTrainStep, make_mesh)
+    finally:
+        if prev is None:
+            del os.environ["MXTPU_BUCKET_BYTES"]
+        else:
+            os.environ["MXTPU_BUCKET_BYTES"] = prev
+
+
+def _dp_step_allreduce_check(ShardedTrainStep, make_mesh):
     mesh = make_mesh(dp=8)
     data = mx.sym.Variable("data")
     net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")

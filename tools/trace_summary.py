@@ -23,9 +23,26 @@ import json
 import sys
 
 
+# collective spans carry an ``nbytes`` attr (parallel/mesh.py) — those
+# get a dedicated bytes/bandwidth table below the phase table
+_COLLECTIVE_PREFIX = "mesh."
+
+
+def _note_collective(coll, name, dur_us, attrs):
+    if not name.startswith(_COLLECTIVE_PREFIX) or not attrs:
+        return
+    nbytes = attrs.get("nbytes")
+    if nbytes is None:
+        return
+    tot_us, cnt, tot_b = coll.get(name, (0.0, 0, 0))
+    coll[name] = (tot_us + dur_us, cnt + 1, tot_b + int(nbytes))
+
+
 def _rows_from_events(events):
-    """(name, total_us, count) rows + wall µs from chrome 'X' events."""
+    """(name, total_us, count) rows + wall µs + collective bytes from
+    chrome 'X' events (span attrs ride the event's ``args``)."""
     agg = {}
+    coll = {}
     t_min, t_max = None, None
     for e in events:
         if e.get("ph") != "X":
@@ -35,15 +52,18 @@ def _rows_from_events(events):
         name = e.get("name", "?")
         tot, cnt = agg.get(name, (0.0, 0))
         agg[name] = (tot + dur, cnt + 1)
+        _note_collective(coll, name, dur, e.get("args"))
         t_min = ts if t_min is None else min(t_min, ts)
         t_max = ts + dur if t_max is None else max(t_max, ts + dur)
     wall = (t_max - t_min) if agg else 0.0
-    return [(n, t, c) for n, (t, c) in agg.items()], wall
+    return [(n, t, c) for n, (t, c) in agg.items()], wall, coll
 
 
 def _rows_from_jsonl(lines):
-    """Span rows + wall µs + last metrics snapshot from telemetry JSONL."""
+    """Span rows + wall µs + last metrics snapshot + collective bytes
+    from telemetry JSONL."""
     agg = {}
+    coll = {}
     t_min, t_max = None, None
     metrics = None
     for line in lines:
@@ -64,16 +84,17 @@ def _rows_from_jsonl(lines):
         name = rec.get("name", "?")
         tot, cnt = agg.get(name, (0.0, 0))
         agg[name] = (tot + dur, cnt + 1)
+        _note_collective(coll, name, dur, rec.get("attrs"))
         t_min = ts if t_min is None else min(t_min, ts)
         t_max = ts + dur if t_max is None else max(t_max, ts + dur)
     wall = (t_max - t_min) if agg else 0.0
-    return [(n, t, c) for n, (t, c) in agg.items()], wall, metrics
+    return [(n, t, c) for n, (t, c) in agg.items()], wall, metrics, coll
 
 
 def load(path):
-    """Returns (rows, wall_us, metrics_or_None). Sniffs the format: a
-    JSON document with 'traceEvents' is a chrome trace, anything else is
-    treated as JSONL."""
+    """Returns (rows, wall_us, metrics_or_None, collectives). Sniffs the
+    format: a JSON document with 'traceEvents' is a chrome trace,
+    anything else is treated as JSONL."""
     with open(path) as f:
         content = f.read()
     try:
@@ -81,8 +102,8 @@ def load(path):
     except ValueError:
         doc = None
     if isinstance(doc, dict) and "traceEvents" in doc:
-        rows, wall = _rows_from_events(doc["traceEvents"])
-        return rows, wall, None
+        rows, wall, coll = _rows_from_events(doc["traceEvents"])
+        return rows, wall, None, coll
     return _rows_from_jsonl(content.splitlines())
 
 
@@ -98,6 +119,21 @@ def format_table(rows, wall_us, top=0):
         out.append("%-32s %8d %12.3f %10.3f %6.1f%%" % (
             name[:32], cnt, tot / 1e3, tot / cnt / 1e3, pct))
     out.append("wall: %.3f ms" % (wall_us / 1e3))
+    return "\n".join(out)
+
+
+def format_collectives(coll):
+    """Bytes/bandwidth table for mesh collectives (reduce_scatter_sum,
+    all_gather, allreduce_sum): what the bucketed sharded-update path is
+    supposed to shrink — see docs/performance.md."""
+    out = ["", "collectives:", "%-28s %6s %10s %10s %10s" % (
+        "op", "count", "total ms", "MiB moved", "MiB/s")]
+    for name in sorted(coll):
+        tot_us, cnt, tot_b = coll[name]
+        mib = tot_b / (1024.0 * 1024.0)
+        rate = mib / (tot_us / 1e6) if tot_us else 0.0
+        out.append("%-28s %6d %10.3f %10.3f %10.1f" % (
+            name[:28], cnt, tot_us / 1e3, mib, rate))
     return "\n".join(out)
 
 
@@ -120,12 +156,36 @@ def format_metrics(metrics):
     return "\n".join(out)
 
 
+def _format_bucket_hist(metrics):
+    """One-line digest of the kvstore.bucket_bytes histogram: how well
+    the GradBucketer coalesced (mean flat-collective payload per flush,
+    split by path=dist / path=flat_update)."""
+    hist = metrics.get("kvstore.bucket_bytes") if metrics else None
+    if not hist:
+        return None
+    lines = ["", "gradient buckets (kvstore.bucket_bytes):"]
+    for stream in hist.get("streams", []):
+        cnt = stream.get("count", 0)
+        if not cnt:
+            continue
+        mean_kib = stream.get("sum", 0.0) / cnt / 1024.0
+        path = (stream.get("labels") or {}).get("path", "?")
+        lines.append("  path=%-12s flushes=%-6d mean bucket %.1f KiB"
+                     % (path, cnt, mean_kib))
+    return "\n".join(lines) if len(lines) > 2 else None
+
+
 def summarize(path, top=0):
-    rows, wall, metrics = load(path)
+    rows, wall, metrics, coll = load(path)
     if not rows and metrics is None:
         return "no span/event records in %s" % path
     text = format_table(rows, wall, top=top) if rows else (
         "no span records in %s" % path)
+    if coll:
+        text += "\n" + format_collectives(coll)
+    bucket = _format_bucket_hist(metrics)
+    if bucket:
+        text += "\n" + bucket
     if metrics:
         text += "\n" + format_metrics(metrics)
     return text
@@ -144,38 +204,64 @@ def _self_test():
         {"name": "fwd", "ph": "X", "ts": 2000.0, "dur": 3000.0, "pid": 0},
         {"name": "bwd", "ph": "X", "ts": 1000.0, "dur": 500.0, "pid": 0},
     ]}
+    trace["traceEvents"].append(
+        {"name": "mesh.all_gather", "ph": "X", "ts": 4000.0,
+         "dur": 200.0, "pid": 0, "args": {"nbytes": 1 << 20}})
     tp = os.path.join(d, "profile.json")
     with open(tp, "w") as f:
         json.dump(trace, f)
-    rows, wall, metrics = load(tp)
+    rows, wall, metrics, coll = load(tp)
     by = {n: (t, c) for n, t, c in rows}
     assert metrics is None
     assert by["fwd"] == (4000.0, 2), by
     assert by["bwd"] == (500.0, 1), by
     assert wall == 5000.0, wall  # 0 .. 2000+3000
+    assert coll["mesh.all_gather"] == (200.0, 1, 1 << 20), coll
 
-    # telemetry JSONL: spans + a metrics snapshot + a torn line
+    # telemetry JSONL: spans (incl. collectives with nbytes attrs) + a
+    # metrics snapshot (incl. the bucket-size histogram) + a torn line
     jp = os.path.join(d, "telemetry.jsonl")
     with open(jp, "w") as f:
         f.write(json.dumps({"type": "span", "name": "fit.step",
                             "ts": 10.0, "dur": 0.5}) + "\n")
         f.write(json.dumps({"type": "span", "name": "fit.step",
                             "ts": 11.0, "dur": 0.25}) + "\n")
+        f.write(json.dumps({"type": "span",
+                            "name": "mesh.reduce_scatter_sum",
+                            "ts": 10.1, "dur": 0.01,
+                            "attrs": {"nbytes": 4096}}) + "\n")
+        f.write(json.dumps({"type": "span",
+                            "name": "mesh.reduce_scatter_sum",
+                            "ts": 10.2, "dur": 0.03,
+                            "attrs": {"nbytes": 8192}}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "mesh.all_gather",
+                            "ts": 10.3, "dur": 0.02,
+                            "attrs": {"nbytes": 4096}}) + "\n")
         f.write(json.dumps({"type": "metrics", "metrics": {
             "mxtpu.demo": {"kind": "counter",
                            "streams": [{"labels": {}, "value": 7}]},
             "mxtpu.lat": {"kind": "histogram",
                           "streams": [{"labels": {"op": "x"},
                                        "count": 2, "sum": 0.75}]},
+            "kvstore.bucket_bytes": {
+                "kind": "histogram",
+                "streams": [{"labels": {"path": "dist"},
+                             "count": 4, "sum": 4 * 2048.0}]},
         }}) + "\n")
         f.write('{"type": "span", "name": "torn')  # no newline, mid-write
-    rows, wall, metrics = load(jp)
+    rows, wall, metrics, coll = load(jp)
     by = {n: (t, c) for n, t, c in rows}
     assert by["fit.step"] == (750000.0, 2), by
     assert abs(wall - 1.25e6) < 1e-6, wall  # 10.0s .. 11.25s
     assert metrics["mxtpu.demo"]["streams"][0]["value"] == 7
+    assert coll["mesh.reduce_scatter_sum"][1] == 2, coll
+    assert coll["mesh.reduce_scatter_sum"][2] == 12288, coll
+    assert coll["mesh.all_gather"] == (20000.0, 1, 4096), coll
     text = summarize(jp)
     assert "fit.step" in text and "mxtpu.demo" in text, text
+    assert "collectives:" in text and "mesh.all_gather" in text, text
+    assert "gradient buckets" in text and "mean bucket 2.0 KiB" in text, \
+        text
     print("self-test passed")
     return 0
 

@@ -33,6 +33,7 @@ SCAN_K = int(os.environ.get("SCORE_SCAN_K", "2" if SMOKE else "16"))
 REPS = int(os.environ.get("SCORE_REPS", "1" if SMOKE else "3"))
 
 from mxnet_tpu import telemetry as _tm  # noqa: E402
+from mxnet_tpu.telemetry import costmodel  # noqa: E402
 
 _H_DISPATCH = _tm.histogram(
     "bench.dispatch_seconds",
@@ -101,6 +102,28 @@ def score(jax, jnp, name, batch, bf16):
 
     run = jax.jit(k_scan)
     x = jnp.asarray(rng.rand(*data_shape), jnp.float32)
+    # per-image FLOPs from both cost models (telemetry/costmodel.py):
+    # XLA's own accounting of the program we actually run, and the
+    # hand-counted conv/FC MACs — BENCH jsons carry both so the MFU can
+    # be cross-checked against the classical number
+    flops = {}
+    try:
+        # XLA's HloCostAnalysis sums each loop BODY once (trip count is
+        # not multiplied in), so the K-scan program's cost is already
+        # one forward pass: divide by batch only
+        cost = costmodel.extract_cost(run.lower(x).compile())
+        if cost["flops"]:
+            flops["xla_flops_per_image"] = cost["flops"] / batch
+        if cost["bytes_accessed"]:
+            flops["xla_bytes_per_image"] = cost["bytes_accessed"] / batch
+    except Exception:  # noqa: BLE001 — accounting must not break scoring
+        pass
+    try:
+        flops["analytic_flops_per_image"] = (
+            costmodel.analytic_forward_flops(
+                sym, data=data_shape, softmax_label=(batch,)) / batch)
+    except Exception:  # noqa: BLE001
+        pass
     out = run(x)
     float(out.ravel()[0].astype(jnp.float32))  # compile + warm
     t0 = time.perf_counter()
@@ -122,7 +145,7 @@ def score(jax, jnp, name, batch, bf16):
     out.block_until_ready()
     n_img = batch * SCAN_K * REPS
     return (n_img / dtime, 1000.0 * dtime / (SCAN_K * REPS),
-            1000.0 * min(disp))
+            1000.0 * min(disp), flops)
 
 
 def main():
@@ -139,8 +162,9 @@ def main():
             for bf16 in ([True, False] if (on_tpu and
                          os.environ.get("SCORE_F32") == "1")
                          else [on_tpu]):
-                img_s, step_ms, disp_ms = score(jax, jnp, name, batch, bf16)
-                rows.append({
+                img_s, step_ms, disp_ms, flops = score(
+                    jax, jnp, name, batch, bf16)
+                row = {
                     "network": name, "batch": batch,
                     "dtype": "bf16" if bf16 else "f32",
                     "images_per_sec": round(img_s, 1),
@@ -148,11 +172,35 @@ def main():
                     # BENCH_* rounds track this next to img/s: the
                     # async-pipeline target is <2 ms (ISSUE 3)
                     "dispatch_overhead_ms": round(disp_ms, 3),
-                })
+                }
+                for k, v in flops.items():
+                    row[k] = round(v, 1)
+                fx = flops.get("xla_flops_per_image")
+                fa = flops.get("analytic_flops_per_image")
+                if fx and fa:
+                    # the anatomy acceptance gate: XLA's accounting and
+                    # the hand count should agree within ~10% on convnets
+                    row["flops_xla_vs_analytic"] = round(fx / fa, 4)
+                peak = costmodel.peak_flops_for_kind(
+                    getattr(dev, "device_kind", ""))
+                fl = fx or fa
+                if peak and fl:
+                    # forward-only MFU at the measured wall rate — the
+                    # scaling model (scaling_model_r5.json) tracks this
+                    # toward the 70% target
+                    row["mfu"] = round(img_s * fl / peak, 4)
+                rows.append(row)
                 print(json.dumps(rows[-1]), file=sys.stderr)
+    _peak = costmodel.peak_flops_for_kind(getattr(dev, "device_kind", ""))
     out = {
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
+        "anatomy": {
+            "peak_tflops": _peak / 1e12 if _peak else None,
+            "flops_convention": "2 MACs per multiply-add, forward only; "
+                                "xla_* fields are cost_analysis() of the "
+                                "scanned program divided back per image",
+        },
         "scan_k": SCAN_K,
         "reference_anchor": "example/image-classification/"
                             "benchmark_score.py (K80 CUDA 7.5: resnet-50 "

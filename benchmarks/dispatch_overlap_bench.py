@@ -43,6 +43,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -94,6 +95,48 @@ def _iter():
     return mx.io.NDArrayIter(X, y, batch_size=BATCH)
 
 
+def _anatomy_summary(jl_path):
+    """Digest the {"type": "anatomy"} interval records of one fit run:
+    per-step phase breakdown over the steady (post-warmup) intervals,
+    the explicit unattributed remainder, and the record invariant that
+    named phases + unattributed == measured wall (ISSUE 6 acceptance)."""
+    from mxnet_tpu import telemetry
+
+    telemetry.flush()
+    from tools.trace_summary import load_anatomy
+
+    recs = load_anatomy(jl_path)
+    if not recs:
+        return None
+    sums_ok = all(
+        abs(sum(r["phases"].values()) + r["unattributed_seconds"]
+            - r["wall_seconds"]) < 1e-6
+        for r in recs)
+    steady = recs[1:] if len(recs) > 1 else recs
+    steps = sum(r["steps"] for r in steady) or 1
+    out = {
+        "intervals": len(recs),
+        "steady_step_ms": round(
+            1000.0 * sum(r["wall_seconds"] for r in steady) / steps, 4),
+        "phases_ms_per_step": {
+            k: round(1000.0 * sum(r["phases"].get(k, 0.0)
+                                  for r in steady) / steps, 4)
+            for k in recs[0]["phases"]},
+        "unattributed_ms_per_step": round(
+            1000.0 * sum(r["unattributed_seconds"] for r in steady)
+            / steps, 4),
+        "phases_plus_unattributed_equals_wall": sums_ok,
+        "recompiles": sum(r.get("recompiles", 0) for r in recs),
+    }
+    mfus = [r["mfu"] for r in recs if r.get("mfu") is not None]
+    if mfus:
+        out["mfu_last"] = round(mfus[-1], 4)
+    bounds = [r.get("roofline", {}).get("bound") for r in steady]
+    if any(bounds):
+        out["roofline_bound"] = bounds[-1]
+    return out
+
+
 def measure(mode):
     """Two fit epochs (warm + measured); returns per-step host dispatch
     ms over the measured epoch plus the final Train metric for the
@@ -110,7 +153,12 @@ def measure(mode):
         os.environ["MXTPU_METRIC_INTERVAL"] = str(METRIC_IV)
     try:
         telemetry.reset()
-        telemetry.enable()
+        # JSONL sink so the anatomy layer's per-interval step records
+        # land on disk; epoch boundaries force-close an interval, so a
+        # 2-epoch fit yields (warmup, measured) records
+        jl_path = os.path.join(
+            tempfile.mkdtemp(prefix="dob_anatomy_"), mode + ".jsonl")
+        telemetry.enable(jsonl=jl_path)
         stage = telemetry.histogram("module.stage_host_seconds")
         hist = telemetry.histogram("module.dispatch_host_seconds")
         mx.random.seed(0)
@@ -144,6 +192,7 @@ def measure(mode):
             "measured_steps": sc2 - sc1,
             "train_metric": metric.get()[1],
             "wall_s": round(wall, 2),
+            "anatomy": _anatomy_summary(jl_path),
         }
     finally:
         for k in _ENV_KNOBS:
@@ -174,6 +223,10 @@ def main():
         if async_ms else None,
         "async_under_2ms": bool(async_ms < 2.0),
         "metric_parity": rows[0]["train_metric"] == rows[1]["train_metric"],
+        "anatomy_sum_matches_wall": all(
+            r["anatomy"] is not None
+            and r["anatomy"]["phases_plus_unattributed_equals_wall"]
+            for r in rows),
         "target": "<2 ms/step host staging, >=3x reduction vs sync "
                   "(ISSUE 3 acceptance; dispatch_host_ms and wall_s "
                   "recorded as context — CPU enqueue blocks on donated "

@@ -217,6 +217,10 @@ class _GraphProgram:
         plan = self._dispatch_plans.get(sig)
         if plan is None:
             _M_PLAN_MISSES.inc()
+            # a miss past warmup is a fresh trace/compile on the hot
+            # path — the anatomy layer fingerprints it and diffs against
+            # the previous signature (no-op unless telemetry is on)
+            _tm.anatomy.note_plan_miss(self._program_uid, sig)
             plan = build()
             self._dispatch_plans[sig] = plan
         else:

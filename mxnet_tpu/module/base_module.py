@@ -335,6 +335,7 @@ class BaseModule(object):
             loop["gs"] += n_new
             loop["done"] = done
             loop["epoch"] = epoch
+            _tm.anatomy.on_steps(n_new)
             if ckpt_mgr is None:
                 return
             if preempt["flag"]:
@@ -394,6 +395,7 @@ class BaseModule(object):
                     loop, _capture, resume_skip, resume_metric):
         """Epoch loop body of :meth:`fit` (split out so the signal-window
         try/finally in fit stays readable)."""
+        _tm.anatomy.begin_loop()
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -499,6 +501,9 @@ class BaseModule(object):
                 _flush_group(pending, epoch, eval_metric)
                 pending = []
             _drain_metrics()  # deferred fetches land before epoch stats
+            # close the partial anatomy interval on the epoch boundary so
+            # its phase deltas land in the same JSONL flush below
+            _tm.anatomy.emit_interval(force=True)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -45,6 +45,12 @@ _M_FEED_HITS = _tm.counter(
     "Fused-step input arrays adopted directly from a DeviceFeedIter "
     "staging (sharding matched: no asnumpy sync, no per-step "
     "device_put)")
+_H_OUTPUT_SYNC = _tm.histogram(
+    "module.output_sync_seconds",
+    "Host wall time blocked pulling fused-step outputs to host "
+    "(update_metric / deferred metric drain). Under the async pipeline "
+    "this is where device compute surfaces on the host thread — the "
+    "device-sync leg of the step anatomy (telemetry/anatomy.py)")
 
 
 def _local_rows(arr):
@@ -775,8 +781,10 @@ class Module(BaseModule):
 
     def _materialized_fused_outputs(self):
         if self._fused_outputs is None and self._fused_outs_raw is not None:
+            t0 = time.perf_counter()
             self._fused_outputs = [
                 nd.NDArray(_local_rows(o)) for o in self._fused_outs_raw]
+            _H_OUTPUT_SYNC.observe(time.perf_counter() - t0)
         return self._fused_outputs
 
     def get_outputs(self, merge_multi_context=True):
@@ -823,8 +831,10 @@ class Module(BaseModule):
         """Drain one deferred step: the blocking host transfer happens
         HERE, k steps behind the dispatch frontier; accumulation math
         and order match an immediate update_metric exactly."""
+        t0 = time.perf_counter()
         eval_metric.update(
             labels, [nd.NDArray(_local_rows(o)) for o in snapshot])
+        _H_OUTPUT_SYNC.observe(time.perf_counter() - t0)
 
     def _sync_params_from_devices(self):
         """Parity module.py:666."""

@@ -728,6 +728,13 @@ class ShardedTrainStep:
         import jax
 
         self._step = jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
+        try:
+            _tm.anatomy.register_program(
+                self.program._program_uid,
+                mesh=str(dict(self.mesh.shape)),
+                donation="params,aux,opt_state")
+        except Exception:  # noqa: BLE001 — observer only
+            pass
         return self
 
     def compile_multi(self, k):
@@ -803,11 +810,23 @@ class ShardedTrainStep:
             rngs = jnp.stack([_random.next_key() for _ in range(k)])
         else:
             rngs = jnp.zeros((k, 2), jnp.uint32)
+        lrs_arr = jnp.asarray(lrs, jnp.float32)
+        ts_arr = jnp.asarray(ts, jnp.float32)
+        if _tm.anatomy.wants_cost():
+            # AOT lower+compile BEFORE the donating dispatch (lower does
+            # not consume buffers); cached per signature, so the steady
+            # state pays a dict lookup. No steps=k division: XLA's cost
+            # analysis sums the scan BODY once (trip count is not
+            # multiplied in), so the K-step program already reports
+            # per-step cost
+            _tm.anatomy.capture_cost(
+                self.program._program_uid, ("multi", k) + sig,
+                lambda: fn.lower(params, aux, opt_state, batches, rngs,
+                                 lrs_arr, ts_arr).compile())
         _M_STEPS.inc(k, path="multi")
         with _tm.span("train_step.dispatch", k=k):
             return fn(params, aux, opt_state, batches, rngs,
-                      jnp.asarray(lrs, jnp.float32),
-                      jnp.asarray(ts, jnp.float32))
+                      lrs_arr, ts_arr)
 
     def __call__(self, params, aux, opt_state, batch, rng=None, lr=None, t=1):
         assert self._step is not None, "call compile() first"
@@ -848,9 +867,14 @@ class ShardedTrainStep:
                 rng = _random.next_key()
             else:
                 rng = jnp.zeros((2,), jnp.uint32)  # unused placeholder
+        lr_arr = jnp.asarray(lr, jnp.float32)
+        t_arr = jnp.asarray(t, jnp.float32)
+        if _tm.anatomy.wants_cost():
+            _tm.anatomy.capture_cost(
+                self.program._program_uid, ("single",) + sig,
+                lambda: self._step.lower(params, aux, opt_state, batch,
+                                         rng, lr_arr, t_arr).compile())
         _M_STEPS.inc(path="single")
         with _tm.span("train_step.dispatch", t=t):
-            return self._step(
-                params, aux, opt_state, batch, rng,
-                jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.float32),
-            )
+            return self._step(params, aux, opt_state, batch, rng,
+                              lr_arr, t_arr)

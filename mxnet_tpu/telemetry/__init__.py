@@ -31,12 +31,15 @@ from . import registry as _registry
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, Registry, REGISTRY,
     counter, gauge, histogram, render_prometheus, snapshot, enabled,
+    percentile_from_counts, total,
 )
 from .tracer import span, current_span, Span  # noqa: F401
 from .export import (  # noqa: F401
     sample_device_memory, write_prometheus_file, set_prometheus_file,
     jsonl_path,
 )
+from . import anatomy  # noqa: F401  (step anatomy / MFU / recompiles)
+from . import costmodel  # noqa: F401
 
 
 def enable(jsonl=None, prometheus=None, prometheus_interval=None):
@@ -68,6 +71,7 @@ def reset():
     """Zero all metric values and detach the JSONL sink — test isolation
     helper. Metric handles held by instrument sites stay registered."""
     _registry.REGISTRY.reset_values()
+    anatomy.reset_state()
     _export.set_jsonl_path(None)
     _export.stop_prom_thread()
     _export.set_prometheus_file(None)

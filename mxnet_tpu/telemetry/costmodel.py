@@ -1,0 +1,207 @@
+"""Device cost model: FLOPs/bytes per compiled program + roofline math.
+
+Two independent sources of truth, cross-checked in benchmark_score.py:
+
+- :func:`extract_cost` reads XLA's own accounting
+  (``compiled.cost_analysis()``) — exact for whatever XLA actually
+  compiled, but only available after an AOT lower+compile.
+- :func:`analytic_forward_flops` walks the symbol graph and counts
+  conv/FC MACs by hand — the classical "2*N*K*OH*OW*C/g*kh*kw" number
+  papers quote MFU against, independent of XLA's fusion decisions.
+
+Peak-rate tables mirror ``benchmarks/bench.py`` (per-chip dense
+bf16/f32 peaks from public TPU specs); ``MXTPU_ANATOMY_PEAK_TFLOPS`` /
+``MXTPU_ANATOMY_PEAK_GBPS`` override both for unlisted hardware and for
+deterministic CPU tests. Stdlib-only at import (jax stays lazy) so
+telemetry keeps its no-cycle guarantee.
+"""
+from __future__ import annotations
+
+import os
+
+# substring-matched against jax's device_kind, first hit wins — order
+# matters ("v5 lite" before "v5"). Dense peak TFLOP/s per chip.
+_KIND_PEAK_TFLOPS = (
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+# HBM bandwidth GB/s per chip (public spec sheets)
+_KIND_HBM_GBPS = (
+    ("v6e", 1640.0),
+    ("v6 lite", 1640.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v5litepod", 819.0),
+    ("v5", 2765.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def _lookup(kind, table):
+    k = (kind or "").lower()
+    for sub, peak in table:
+        if sub in k:
+            return peak
+    return None
+
+
+def peak_flops_for_kind(kind):
+    """Peak FLOP/s for a device kind, or None if unknown.
+    ``MXTPU_ANATOMY_PEAK_TFLOPS`` (in TFLOP/s) overrides the table."""
+    env = os.environ.get("MXTPU_ANATOMY_PEAK_TFLOPS")
+    if env:
+        try:
+            return float(env) * 1e12
+        except ValueError:
+            pass
+    tf = _lookup(kind, _KIND_PEAK_TFLOPS)
+    return tf * 1e12 if tf is not None else None
+
+
+def peak_bytes_for_kind(kind):
+    """Peak HBM bytes/s for a device kind, or None if unknown.
+    ``MXTPU_ANATOMY_PEAK_GBPS`` (in GB/s) overrides the table."""
+    env = os.environ.get("MXTPU_ANATOMY_PEAK_GBPS")
+    if env:
+        try:
+            return float(env) * 1e9
+        except ValueError:
+            pass
+    gb = _lookup(kind, _KIND_HBM_GBPS)
+    return gb * 1e9 if gb is not None else None
+
+
+def extract_cost(compiled):
+    """Pull {"flops", "bytes_accessed"} out of a jax AOT ``Compiled``.
+
+    ``cost_analysis()`` has returned a dict, a list of one dict per
+    partition, and None across jax versions; any shape degrades to None
+    fields rather than raising — cost capture must never break dispatch.
+    """
+    out = {"flops": None, "bytes_accessed": None}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return out
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return out
+    for field, key in (("flops", "flops"),
+                       ("bytes_accessed", "bytes accessed")):
+        v = ca.get(key)
+        if v is None:
+            v = ca.get(key.replace(" ", "_"))
+        try:
+            if v is not None:
+                out[field] = float(v)
+        except (TypeError, ValueError):
+            pass
+    return out
+
+
+def classify(flops, bytes_accessed, wall_seconds, comm_seconds,
+             peak_flops, peak_bytes):
+    """Roofline classification of one interval.
+
+    Returns {"bound", "t_compute", "t_memory", "t_comm"} where the t_*
+    legs are the minimum times the interval's work would take at peak
+    compute rate, peak HBM rate, and the measured collective time. The
+    binding resource is the largest leg; "host" when even that leg
+    explains under ~30% of the wall (the step is dominated by time the
+    device model cannot see); "unknown" without peak rates.
+    """
+    legs = {}
+    if flops and peak_flops:
+        legs["t_compute"] = flops / peak_flops
+    if bytes_accessed and peak_bytes:
+        legs["t_memory"] = bytes_accessed / peak_bytes
+    if comm_seconds:
+        legs["t_comm"] = comm_seconds
+    out = {"t_compute": legs.get("t_compute"),
+           "t_memory": legs.get("t_memory"),
+           "t_comm": legs.get("t_comm")}
+    if not legs:
+        out["bound"] = "unknown"
+        return out
+    name, t = max(legs.items(), key=lambda kv: kv[1])
+    if wall_seconds and t < 0.3 * wall_seconds:
+        out["bound"] = "host"
+    else:
+        out["bound"] = {"t_compute": "compute", "t_memory": "memory",
+                        "t_comm": "comm"}[name]
+    return out
+
+
+def analytic_forward_flops(symbol, **input_shapes):
+    """Hand-counted forward FLOPs for one batch through ``symbol``.
+
+    Counts the dense-algebra ops (Convolution, Deconvolution,
+    FullyConnected) that dominate model FLOPs — the convention MFU
+    numbers are quoted in (2 MACs per multiply-add, bias adds included).
+    A training step is ~3x this (forward + 2x backward).
+    """
+    internals = symbol.get_internals()
+    names = internals.list_outputs()
+    _, oshapes, _ = internals.infer_shape(**input_shapes)
+    shape_of = dict(zip(names, oshapes))
+
+    def _in_shape(node, i):
+        inode, iidx = node.inputs[i]
+        return shape_of.get(inode.output_names()[iidx])
+
+    total = 0.0
+    for node in symbol._nodes():
+        if node.is_variable:
+            continue
+        op = node.op.name
+        if op not in ("Convolution", "Deconvolution", "FullyConnected"):
+            continue
+        out = shape_of.get(node.output_names()[0])
+        dat = _in_shape(node, 0)
+        if out is None or dat is None:
+            continue
+        attrs = node.canon_attrs()
+        n_out = 1
+        for d in out:
+            n_out *= int(d)
+        if op == "FullyConnected":
+            # data flattens to (N, prod(rest)); weight is (out, in)
+            in_feat = 1
+            for d in dat[1:]:
+                in_feat *= int(d)
+            total += 2.0 * n_out * in_feat
+        else:
+            from ..ops.utils import as_tuple
+
+            kernel = as_tuple(attrs.get("kernel"), name="kernel") or (1,)
+            groups = max(int(attrs.get("num_group", 1)), 1)
+            k_elems = 1
+            for d in kernel:
+                k_elems *= int(d)
+            if op == "Convolution":
+                # each output element reduces over C_in/g * prod(kernel)
+                total += 2.0 * n_out * (int(dat[1]) // groups) * k_elems
+            else:
+                # Deconvolution scatters each INPUT element into
+                # num_filter/g * prod(kernel) outputs
+                n_in = 1
+                for d in dat:
+                    n_in *= int(d)
+                nf = int(attrs.get("num_filter", 1))
+                total += 2.0 * n_in * (nf // groups) * k_elems
+        if not attrs.get("no_bias", False):
+            total += float(n_out)
+    return total

@@ -72,6 +72,11 @@ def emit_span(record):
             fh.flush()
 
 
+# any structured record ({"type": "anatomy"|"recompile"|...}) goes down
+# the same sink; the span name is historical
+emit_record = emit_span
+
+
 def flush_metrics():
     """Append a registry snapshot to the JSONL sink and rewrite the
     Prometheus file, whichever are configured."""

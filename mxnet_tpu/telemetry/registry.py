@@ -19,6 +19,7 @@ the Prometheus renderer sanitizes to ``engine_ops_pushed`` at the edge.
 """
 from __future__ import annotations
 
+import logging
 import math
 import os
 import threading
@@ -46,6 +47,18 @@ def _label_key(labels):
     return tuple(sorted(labels.items())) if labels else ()
 
 
+# per-shape/per-key labels can grow without bound in long runs; past this
+# many distinct label sets a metric folds new ones into one overflow stream
+_OVERFLOW_KEY = (("overflow", "true"),)
+
+
+def _max_label_sets():
+    try:
+        return int(os.environ.get("MXTPU_METRIC_MAX_LABELS", "256"))
+    except ValueError:
+        return 256
+
+
 class _Metric:
     """Base: one named instrument holding per-label-set streams."""
 
@@ -56,6 +69,23 @@ class _Metric:
         self.help = help
         self._lock = threading.Lock()
         self._values = {}  # label-key tuple -> stream state
+        self._overflowed = False
+
+    def _slot(self, key):
+        """Cardinality guard — call under ``self._lock``. Existing keys
+        always pass; a NEW key past MXTPU_METRIC_MAX_LABELS folds into the
+        overflow stream (warn once per metric)."""
+        if key in self._values or key == _OVERFLOW_KEY:
+            return key
+        if len(self._values) < _max_label_sets():
+            return key
+        if not self._overflowed:
+            self._overflowed = True
+            logging.getLogger("mxnet_tpu.telemetry").warning(
+                "metric %s exceeded MXTPU_METRIC_MAX_LABELS=%d distinct "
+                "label sets; further new label sets fold into "
+                "{overflow=\"true\"}", self.name, _max_label_sets())
+        return _OVERFLOW_KEY
 
     def label_sets(self):
         with self._lock:
@@ -64,6 +94,7 @@ class _Metric:
     def clear(self):
         with self._lock:
             self._values.clear()
+            self._overflowed = False
 
 
 class Counter(_Metric):
@@ -79,6 +110,7 @@ class Counter(_Metric):
             raise ValueError("counter %s: negative increment" % self.name)
         key = _label_key(labels)
         with self._lock:
+            key = self._slot(key)
             self._values[key] = self._values.get(key, 0) + amount
 
     def value(self, **labels):
@@ -96,6 +128,7 @@ class Gauge(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
+            key = self._slot(key)
             self._values[key] = value
 
     def inc(self, amount=1, **labels):
@@ -103,6 +136,7 @@ class Gauge(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
+            key = self._slot(key)
             self._values[key] = self._values.get(key, 0) + amount
 
     def dec(self, amount=1, **labels):
@@ -135,6 +169,7 @@ class Histogram(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
+            key = self._slot(key)
             state = self._values.get(key)
             if state is None:
                 state = {"counts": [0] * (len(self.buckets) + 1),
@@ -158,6 +193,43 @@ class Histogram(_Metric):
         with self._lock:
             state = self._values.get(_label_key(labels))
             return state["sum"] if state else 0.0
+
+    def percentile(self, q, **labels):
+        """Estimated q-th percentile (q in 0..100) from bucket counts.
+
+        Defined on every histogram state: 0.0 when empty, the exact
+        sample when count == 1, linear interpolation inside the bucket
+        otherwise (+Inf bucket clamps to the top finite edge).
+        """
+        with self._lock:
+            state = self._values.get(_label_key(labels))
+            if not state:
+                return 0.0
+            return percentile_from_counts(
+                self.buckets, state["counts"], state["count"],
+                state["sum"], q)
+
+
+def percentile_from_counts(buckets, counts, count, total_sum, q):
+    """Percentile estimate from exported histogram state — shared by the
+    live :meth:`Histogram.percentile` and offline JSONL readers
+    (tools/perf_doctor.py) so both agree on edge cases."""
+    if count <= 0:
+        return 0.0
+    if count == 1:
+        return float(total_sum)  # the single sample, exactly
+    q = min(max(float(q), 0.0), 100.0)
+    target = q / 100.0 * count
+    cum = 0
+    lo = 0.0
+    for i, edge in enumerate(buckets):
+        c = counts[i]
+        if c > 0 and cum + c >= target:
+            return lo + (float(edge) - lo) * ((target - cum) / c)
+        cum += c
+        lo = float(edge)
+    # everything left is in the +Inf bucket: clamp to the top finite edge
+    return float(buckets[-1]) if buckets else float(total_sum) / count
 
 
 class Registry:
@@ -192,6 +264,20 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def total(self, name):
+        """Aggregate a metric across ALL label sets: counters/gauges sum
+        their values, histograms sum their ``sum`` fields. Missing metric
+        reads as 0.0 — callers take interval deltas and must not care
+        whether an instrument fired yet."""
+        m = self.get(name)
+        if m is None:
+            return 0.0
+        with m._lock:
+            vals = list(m._values.values())
+        if m.kind == "histogram":
+            return float(sum(v["sum"] for v in vals))
+        return float(sum(vals))
+
     def metrics(self):
         with self._lock:
             return list(self._metrics.values())
@@ -217,7 +303,8 @@ class Registry:
                 if m.kind == "histogram":
                     streams.append({"labels": labels, "sum": val["sum"],
                                     "count": val["count"],
-                                    "counts": list(val["counts"])})
+                                    "counts": list(val["counts"]),
+                                    "buckets": list(m.buckets)})
                 else:
                     streams.append({"labels": labels, "value": val})
             out[m.name] = {"kind": m.kind, "streams": streams}
@@ -289,3 +376,4 @@ gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
 render_prometheus = REGISTRY.render_prometheus
 snapshot = REGISTRY.snapshot
+total = REGISTRY.total

@@ -3,6 +3,7 @@ and the end-to-end observability smoke (tiny Module.fit producing a
 chrome trace with nested framework spans plus JSONL/Prometheus metrics).
 """
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -119,6 +120,86 @@ def test_render_prometheus_exposition():
     assert counts == sorted(counts)
     assert counts[-1] == 2.0
     assert 'le="+Inf"' in lines[-1]
+
+
+def test_histogram_percentile_semantics():
+    tm.enable()
+    h = tm.histogram("t.pctl", "percentile semantics")
+    # empty state is defined: 0.0, never an exception
+    assert h.percentile(50) == 0.0
+    # a single sample returns that sample exactly, not a bucket estimate
+    h.observe(0.007)
+    assert h.percentile(50) == 0.007
+    assert h.percentile(99) == 0.007
+
+    # multi-sample: linear interpolation inside the owning bucket —
+    # 90 samples in (0.005, 0.01], 10 in (0.01, 0.025]
+    h2 = tm.histogram("t.pctl2", "interpolated")
+    for _ in range(90):
+        h2.observe(0.008)
+    for _ in range(10):
+        h2.observe(0.02)
+    assert abs(h2.percentile(50)
+               - (0.005 + 0.005 * (50 / 90.0))) < 1e-12
+    assert abs(h2.percentile(99)
+               - (0.01 + 0.015 * (9 / 10.0))) < 1e-12
+    # labeled streams keep independent states
+    h2.observe(1.0, stream="other")
+    assert h2.percentile(50, stream="other") == 1.0
+
+    # samples past the top edge live in +Inf: clamp to the top finite
+    # edge rather than inventing a value
+    h3 = tm.histogram("t.pctl3", "inf clamp")
+    h3.observe(100.0)
+    h3.observe(200.0)
+    assert h3.percentile(99) == 30.0
+
+    # the offline helper (perf_doctor reads snapshots with it) agrees
+    # with the live method on the same state
+    from mxnet_tpu.telemetry.registry import percentile_from_counts
+
+    snap = tm.snapshot()["t.pctl2"]["streams"]
+    st = next(s for s in snap if s["labels"] == {})
+    assert percentile_from_counts(
+        tuple(st["buckets"]), st["counts"], st["count"], st["sum"], 99
+    ) == h2.percentile(99)
+
+
+def test_label_cardinality_guard(monkeypatch, caplog):
+    tm.enable()
+    monkeypatch.setenv("MXTPU_METRIC_MAX_LABELS", "4")
+    c = tm.counter("t.cardinality", "guarded counter")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.telemetry"):
+        for i in range(10):
+            c.inc(1, route="r%d" % i)
+    # 4 real streams survive; the other 6 increments folded into the
+    # single overflow stream
+    assert len(c.label_sets()) == 5
+    assert c.value(overflow="true") == 6
+    for i in range(4):
+        assert c.value(route="r%d" % i) == 1
+    warns = [r for r in caplog.records
+             if "MXTPU_METRIC_MAX_LABELS" in r.getMessage()]
+    assert len(warns) == 1, "guard must warn exactly once per metric"
+
+    # existing label sets keep recording after the guard trips
+    c.inc(1, route="r0")
+    assert c.value(route="r0") == 2
+
+    # histograms fold the same way
+    h = tm.histogram("t.cardhist", "guarded histogram")
+    for i in range(6):
+        h.observe(0.01, route="r%d" % i)
+    assert h.count(overflow="true") == 2
+
+    # clear() resets the warn-once latch with the streams
+    c.clear()
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.telemetry"):
+        caplog.clear()
+        for i in range(10):
+            c.inc(1, route="s%d" % i)
+    assert any("MXTPU_METRIC_MAX_LABELS" in r.getMessage()
+               for r in caplog.records)
 
 
 # ---------------------------------------------------------------------------

@@ -176,6 +176,15 @@ def test_ckpt_inspect_cli_self_test():
     assert "self-test passed" in res.stdout
 
 
+def test_perf_doctor_cli_self_test():
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.perf_doctor", "--self-test"],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "self-test passed" in res.stdout
+
+
 def test_ckpt_inspect_cli_on_real_checkpoints(tmp_path, capsys):
     from mxnet_tpu.resilience import checkpoint as ck
     from tools import ckpt_inspect

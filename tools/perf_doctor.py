@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""Perf doctor: turn a telemetry JSONL into a step-time diagnosis.
+
+Reads the artifacts the anatomy layer (``mxnet_tpu/telemetry/anatomy.py``)
+writes into the telemetry JSONL stream — ``{"type": "anatomy"}`` interval
+records, ``{"type": "recompile"}`` fingerprint diffs, and the last
+``{"type": "metrics"}`` snapshot — and prints:
+
+* the per-interval step-anatomy table (shared with
+  ``tools/trace_summary.py --anatomy``),
+* the MFU trajectory across intervals,
+* the top recompile causes (grouped by which fingerprint fields changed),
+* a ranked "where the milliseconds went" diagnosis with one actionable
+  hint per phase, naming the largest cost explicitly.
+
+Usage::
+
+    python -m tools.perf_doctor telemetry.jsonl
+    python -m tools.perf_doctor telemetry.jsonl --all-intervals
+    python -m tools.perf_doctor --self-test
+
+The first interval of a run usually carries the warmup compile inside
+its unattributed time; it is dropped from the diagnosis by default
+(``--all-intervals`` keeps it). The table always shows every interval.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.telemetry.registry import percentile_from_counts  # noqa: E402
+from tools.trace_summary import (  # noqa: E402
+    ANATOMY_PHASES, format_anatomy, load_anatomy,
+)
+
+# one actionable hint per phase — the point of the doctor is that the
+# largest line always comes with the knob that shrinks it
+_ADVICE = {
+    "input_wait": "input pipeline starving the device: deepen prefetch "
+                  "(MXTPU_DEVICE_FEED=1 / MXTPU_FEED_DEPTH) or speed up "
+                  "decode",
+    "stage_host": "host input staging: MXTPU_DEVICE_FEED=1 adopts "
+                  "device-resident batches and removes this phase",
+    "dispatch_host": "per-dispatch host overhead: raise "
+                     "MXNET_FIT_MULTISTEP to amortize K steps per "
+                     "dispatch",
+    "device_sync": "blocked on device results: device compute dominates "
+                   "— see the roofline bound for which resource to "
+                   "attack",
+    "collective": "gradient collectives: tune MXTPU_BUCKET_BYTES / "
+                  "MXTPU_BUCKET_TWO_PHASE, or shard the update "
+                  "(MXTPU_SHARD_UPDATE)",
+    "unattributed": "host time no instrumented phase covers: python "
+                    "loop/callback overhead, GC, or compile — check "
+                    "anatomy.recompiles and profile the fit loop",
+}
+
+
+def load_records(path):
+    """(anatomy, recompiles, last-metrics) from one telemetry JSONL."""
+    anatomy, recompiles, metrics = [], [], None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn trailing line (live file)
+            t = rec.get("type")
+            if t == "anatomy":
+                anatomy.append(rec)
+            elif t == "recompile":
+                recompiles.append(rec)
+            elif t == "metrics":
+                metrics = rec.get("metrics")
+    return anatomy, recompiles, metrics
+
+
+def steady_intervals(records, keep_all=False):
+    """Drop the warmup interval (the first one, whose unattributed time
+    contains the compile) when there is anything after it."""
+    if keep_all or len(records) < 2:
+        return records
+    return records[1:]
+
+
+def diagnose(records):
+    """Rank phases + unattributed by total seconds across intervals.
+
+    Returns (ranked, steps, wall_seconds) where ranked is a list of
+    (name, seconds, per_step_ms, pct_of_wall) sorted most-expensive
+    first — ranked[0] IS the diagnosis.
+    """
+    steps = sum(max(int(r.get("steps", 0)), 0) for r in records)
+    wall = sum(float(r.get("wall_seconds", 0.0)) for r in records)
+    totals = {name: 0.0 for name in ANATOMY_PHASES}
+    totals["unattributed"] = 0.0
+    for r in records:
+        phases = r.get("phases", {})
+        for name in ANATOMY_PHASES:
+            totals[name] += float(phases.get(name, 0.0))
+        totals["unattributed"] += float(r.get("unattributed_seconds", 0.0))
+    ranked = []
+    for name, sec in sorted(totals.items(), key=lambda kv: -kv[1]):
+        per_ms = 1000.0 * sec / steps if steps else 0.0
+        pct = 100.0 * sec / wall if wall else 0.0
+        ranked.append((name, sec, per_ms, pct))
+    return ranked, steps, wall
+
+
+def format_mfu_trajectory(records):
+    pts = [(int(r.get("interval", i)), r["mfu"])
+           for i, r in enumerate(records) if r.get("mfu") is not None]
+    if not pts:
+        return ("no MFU values (cost model unresolved: check "
+                "MXTPU_ANATOMY_COSTS and the peak-rate table / "
+                "MXTPU_ANATOMY_PEAK_TFLOPS)")
+    traj = " -> ".join("%.3f" % m for _, m in pts)
+    vals = [m for _, m in pts]
+    return "%s   (min %.3f, max %.3f, last %.3f over %d intervals)" % (
+        traj, min(vals), max(vals), vals[-1], len(pts))
+
+
+def recompile_causes(recompiles):
+    """Group recompile records by WHICH fields changed; most frequent
+    first. Returns [(count, cause, example_detail)]."""
+    groups = {}
+    for rec in recompiles:
+        diff = rec.get("diff") or {}
+        parts = []
+        for name, fields in sorted((diff.get("changed") or {}).items()):
+            for f in sorted(fields):
+                parts.append("%s.%s" % (name, f))
+        if diff.get("added"):
+            parts.append("added:%s" % ",".join(diff["added"]))
+        if diff.get("removed"):
+            parts.append("removed:%s" % ",".join(diff["removed"]))
+        for f in sorted(diff.get("meta") or {}):
+            parts.append("meta.%s" % f)
+        cause = " ".join(parts) or "(no visible diff)"
+        cnt, example = groups.get(cause, (0, None))
+        if example is None:
+            changed = diff.get("changed") or {}
+            for name, fields in sorted(changed.items()):
+                for f, wasnow in sorted(fields.items()):
+                    example = "%s.%s %s -> %s" % (
+                        name, f, wasnow.get("was"), wasnow.get("now"))
+                    break
+                break
+        groups[cause] = (cnt + 1, example)
+    return sorted(((cnt, cause, ex) for cause, (cnt, ex) in groups.items()),
+                  reverse=True)
+
+
+def _step_latency_percentiles(metrics):
+    """p50/p99 of fit.step_seconds from the last metrics snapshot, using
+    the same bucket interpolation as the live registry (the snapshot
+    carries bucket edges since the anatomy PR)."""
+    hist = (metrics or {}).get("fit.step_seconds")
+    if not hist:
+        return None
+    agg_counts, agg_sum, agg_n, buckets = None, 0.0, 0, None
+    for stream in hist.get("streams", []):
+        b = stream.get("buckets")
+        c = stream.get("counts")
+        if not b or not c:
+            continue
+        if agg_counts is None:
+            buckets, agg_counts = b, list(c)
+        elif b == buckets:
+            agg_counts = [x + y for x, y in zip(agg_counts, c)]
+        agg_sum += stream.get("sum", 0.0)
+        agg_n += stream.get("count", 0)
+    if not agg_n or buckets is None:
+        return None
+    return tuple(percentile_from_counts(buckets, agg_counts, agg_n,
+                                        agg_sum, q) for q in (50, 99))
+
+
+def report(path, keep_all=False):
+    anatomy, recompiles, metrics = load_records(path)
+    out = ["== step anatomy ==", format_anatomy(anatomy)]
+    if not anatomy:
+        return "\n".join(out)
+
+    out += ["", "== MFU trajectory ==", format_mfu_trajectory(anatomy)]
+
+    out += ["", "== recompiles =="]
+    if recompiles:
+        out.append("%d recompile(s) after warmup; top causes:"
+                   % len(recompiles))
+        for cnt, cause, example in recompile_causes(recompiles)[:5]:
+            line = "  %dx %s" % (cnt, cause)
+            if example:
+                line += "   e.g. %s" % example
+            out.append(line)
+    else:
+        out.append("none after warmup (dispatch-plan cache is steady)")
+
+    steady = steady_intervals(anatomy, keep_all=keep_all)
+    ranked, steps, wall = diagnose(steady)
+    out += ["", "== where the milliseconds went (%d steps, %.1f ms/step) =="
+            % (steps, 1000.0 * wall / steps if steps else 0.0)]
+    for i, (name, sec, per_ms, pct) in enumerate(ranked):
+        if sec <= 0.0:
+            continue
+        out.append("%2d. %-14s %8.3f ms/step  %5.1f%%  — %s" % (
+            i + 1, name, per_ms, pct, _ADVICE.get(name, "")))
+    top = ranked[0]
+    roof = (steady[-1].get("roofline") or {}).get("bound") if steady else None
+    diag = "diagnosis: largest cost is %s (%.3f ms/step, %.1f%% of wall)" % (
+        top[0], top[2], top[3])
+    if roof and roof != "unknown":
+        diag += "; device model says the interval is %s-bound" % roof
+    out += ["", diag]
+
+    pcts = _step_latency_percentiles(metrics)
+    if pcts:
+        out.append("step latency p50=%.3f ms p99=%.3f ms (fit.step_seconds)"
+                   % (1000.0 * pcts[0], 1000.0 * pcts[1]))
+    return "\n".join(out)
+
+
+def _self_test():
+    """Synthetic JSONL through the full report; raises on mismatch."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="perf_doctor_test_")
+    path = os.path.join(d, "telemetry.jsonl")
+
+    def anatomy_rec(ivl, phases, unattr, mfu=None, bound=None):
+        rec = {"type": "anatomy", "interval": ivl, "steps": 10,
+               "wall_seconds": sum(phases.values()) + unattr,
+               "step_ms": 100.0 * (sum(phases.values()) + unattr),
+               "phases": phases, "unattributed_seconds": unattr,
+               "recompiles": 0}
+        if mfu is not None:
+            rec["mfu"] = mfu
+            rec["flops_per_step"] = 1e9
+            rec["roofline"] = {"bound": bound or "compute"}
+        return rec
+
+    base = {"input_wait": 0.001, "stage_host": 0.002,
+            "dispatch_host": 0.01, "device_sync": 0.12,
+            "collective": 0.005}
+    with open(path, "w") as f:
+        # interval 0: warmup — huge unattributed (compile); dropped from
+        # the diagnosis by default
+        f.write(json.dumps(anatomy_rec(0, dict(base), 2.0)) + "\n")
+        f.write(json.dumps(anatomy_rec(1, dict(base), 0.01,
+                                       mfu=0.12)) + "\n")
+        f.write(json.dumps(anatomy_rec(2, dict(base), 0.01, mfu=0.14,
+                                       bound="compute")) + "\n")
+        for shape in ([16, 8], [12, 8]):
+            f.write(json.dumps({
+                "type": "recompile", "program": 0,
+                "diff": {"changed": {"data": {"shape": {
+                    "was": [32, 8], "now": shape}}},
+                    "added": [], "removed": []}}) + "\n")
+        f.write(json.dumps({"type": "metrics", "metrics": {
+            "fit.step_seconds": {"kind": "histogram", "streams": [{
+                "labels": {}, "count": 20, "sum": 20 * 0.012,
+                "counts": [0, 0, 0, 0, 18, 2, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0, 0],
+                "buckets": [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                            30.0]}]}}}) + "\n")
+
+    anatomy, recompiles, metrics = load_records(path)
+    assert len(anatomy) == 3 and len(recompiles) == 2, (anatomy, recompiles)
+
+    # steady diagnosis must drop the warmup interval and rank
+    # device_sync (12 ms/step) first; with it kept, the warmup
+    # unattributed (2 s over 10 steps) dominates instead
+    ranked, steps, wall = diagnose(steady_intervals(anatomy))
+    assert steps == 20 and ranked[0][0] == "device_sync", ranked
+    assert abs(ranked[0][2] - 12.0) < 1e-6, ranked
+    ranked_all, _, _ = diagnose(steady_intervals(anatomy, keep_all=True))
+    assert ranked_all[0][0] == "unattributed", ranked_all
+
+    causes = recompile_causes(recompiles)
+    assert causes[0][0] == 2 and causes[0][1] == "data.shape", causes
+
+    traj = format_mfu_trajectory(anatomy)
+    assert "0.120 -> 0.140" in traj and "last 0.140" in traj, traj
+
+    pcts = _step_latency_percentiles(metrics)
+    assert pcts is not None and 0.005 < pcts[0] <= 0.01, pcts
+    assert 0.01 < pcts[1] <= 0.025, pcts
+
+    text = report(path)
+    assert "diagnosis: largest cost is device_sync" in text, text
+    assert "compute-bound" in text, text
+    assert "2x data.shape" in text, text
+    assert "MFU trajectory" in text and "step anatomy" in text, text
+    assert "p50=" in text and "p99=" in text, text
+
+    # empty / anatomy-free file degrades to a message, not a crash
+    empty = os.path.join(d, "empty.jsonl")
+    with open(empty, "w") as f:
+        f.write(json.dumps({"type": "span", "name": "x", "ts": 0,
+                            "dur": 1}) + "\n")
+    assert "no anatomy records" in report(empty)
+    print("self-test passed")
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Diagnose step-time anatomy from a telemetry JSONL")
+    parser.add_argument("path", nargs="?", help="telemetry .jsonl file")
+    parser.add_argument("--all-intervals", action="store_true",
+                        help="include the warmup interval in the "
+                             "diagnosis (kept out by default)")
+    parser.add_argument("--self-test", action="store_true",
+                        help="run built-in checks on synthetic inputs")
+    args = parser.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.path:
+        parser.error("path required (or --self-test)")
+    print(report(args.path, keep_all=args.all_intervals))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,14 @@ time (first start to last end). Usage::
 
     python -m tools.trace_summary profile.json
     python -m tools.trace_summary telemetry.jsonl --top 15
+    python -m tools.trace_summary telemetry.jsonl --anatomy
     python -m tools.trace_summary --self-test
+
+``--anatomy`` renders the step-anatomy intervals
+(``telemetry/anatomy.py`` ``{"type": "anatomy"}`` records): per-step
+phase breakdown, explicit unattributed remainder, MFU, and roofline
+bound per interval. ``tools/perf_doctor.py`` builds a diagnosis on top
+of the same records.
 """
 from __future__ import annotations
 
@@ -175,6 +182,65 @@ def _format_bucket_hist(metrics):
     return "\n".join(lines) if len(lines) > 2 else None
 
 
+# phase columns of an anatomy record, in fit-loop order (matches
+# telemetry/anatomy.py _PHASES)
+ANATOMY_PHASES = ("input_wait", "stage_host", "dispatch_host",
+                  "device_sync", "collective")
+
+
+def load_anatomy(path):
+    """All {"type": "anatomy"} interval records from a telemetry JSONL,
+    in file order."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn trailing line (live file)
+            if rec.get("type") == "anatomy":
+                records.append(rec)
+    return records
+
+
+def format_anatomy(records):
+    """Per-interval table: step time split into named phases (per-step
+    ms) with the unattributed remainder explicit, plus MFU and the
+    roofline bound when the cost model resolved."""
+    if not records:
+        return ("no anatomy records (enable telemetry with a JSONL sink "
+                "and leave MXTPU_ANATOMY on)")
+    head = ("%4s %6s %9s " % ("ivl", "steps", "step ms")
+            + " ".join("%9s" % c[:9] for c in ANATOMY_PHASES)
+            + " %9s %7s %8s" % ("unattrib", "mfu", "bound"))
+    out = ["step anatomy (per-step ms):", head, "-" * len(head)]
+    for r in records:
+        steps = max(int(r.get("steps", 1)), 1)
+
+        def ms(seconds):
+            return 1000.0 * seconds / steps
+
+        phases = r.get("phases", {})
+        mfu = r.get("mfu")
+        out.append(
+            "%4d %6d %9.3f " % (int(r.get("interval", 0)), steps,
+                                float(r.get("step_ms", 0.0)))
+            + " ".join("%9.3f" % ms(float(phases.get(c, 0.0)))
+                       for c in ANATOMY_PHASES)
+            + " %9.3f %7s %8s" % (
+                ms(float(r.get("unattributed_seconds", 0.0))),
+                ("%.3f" % mfu) if mfu is not None else "-",
+                str((r.get("roofline") or {}).get("bound", "-"))))
+    last = records[-1]
+    if last.get("flops_per_step"):
+        out.append("model: %.4g FLOPs/step, %.4g bytes/step" % (
+            last["flops_per_step"], last.get("bytes_per_step") or 0.0))
+    return "\n".join(out)
+
+
 def summarize(path, top=0):
     rows, wall, metrics, coll = load(path)
     if not rows and metrics is None:
@@ -262,6 +328,42 @@ def _self_test():
     assert "collectives:" in text and "mesh.all_gather" in text, text
     assert "gradient buckets" in text and "mean bucket 2.0 KiB" in text, \
         text
+
+    # anatomy intervals: appended to the same JSONL; the span/metrics
+    # readers must keep ignoring them and --anatomy must render them
+    with open(jp, "a") as f:
+        f.write("\n" + json.dumps({
+            "type": "anatomy", "interval": 0, "steps": 4,
+            "wall_seconds": 0.08, "step_ms": 20.0,
+            "phases": {"input_wait": 0.004, "stage_host": 0.002,
+                       "dispatch_host": 0.01, "device_sync": 0.02,
+                       "collective": 0.004},
+            "unattributed_seconds": 0.04, "recompiles": 0}) + "\n")
+        f.write(json.dumps({
+            "type": "anatomy", "interval": 1, "steps": 4,
+            "wall_seconds": 0.04, "step_ms": 10.0,
+            "phases": {"input_wait": 0.0, "stage_host": 0.002,
+                       "dispatch_host": 0.01, "device_sync": 0.02,
+                       "collective": 0.004},
+            "unattributed_seconds": 0.004, "recompiles": 0,
+            "flops_per_step": 2.5e9, "bytes_per_step": 1e8,
+            "mfu": 0.125,
+            "roofline": {"bound": "memory"}}) + "\n")
+    recs = load_anatomy(jp)
+    assert len(recs) == 2, recs
+    rows2, _, _, _ = load(jp)
+    assert {n for n, _, _ in rows2} == {
+        "fit.step", "mesh.reduce_scatter_sum", "mesh.all_gather"}, rows2
+    table = format_anatomy(recs)
+    # interval 1: device_sync 0.02s/4 steps = 5 ms; unattrib 1 ms
+    assert "5.000" in table and "0.125" in table, table
+    assert "memory" in table, table
+    assert "2.5e+09" in table, table
+    # phases + unattributed must reproduce the wall (record invariant)
+    for r in recs:
+        total = sum(r["phases"].values()) + r["unattributed_seconds"]
+        assert abs(total - r["wall_seconds"]) < 1e-9, r
+    assert "no anatomy records" in format_anatomy([])
     print("self-test passed")
     return 0
 
@@ -273,6 +375,9 @@ def main(argv=None):
                         help="profile.json or telemetry .jsonl")
     parser.add_argument("--top", type=int, default=0,
                         help="show only the N most expensive phases")
+    parser.add_argument("--anatomy", action="store_true",
+                        help="show the step-anatomy interval table "
+                             "(telemetry JSONL only)")
     parser.add_argument("--self-test", action="store_true",
                         help="run built-in checks on synthetic inputs")
     args = parser.parse_args(argv)
@@ -280,6 +385,9 @@ def main(argv=None):
         return _self_test()
     if not args.path:
         parser.error("path required (or --self-test)")
+    if args.anatomy:
+        print(format_anatomy(load_anatomy(args.path)))
+        return 0
     print(summarize(args.path, top=args.top))
     return 0
 

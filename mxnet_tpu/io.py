@@ -501,8 +501,19 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def skip(self, num_batches):
-        # cursor math, no data touched: resume repositioning is O(1)
-        self.cursor += int(num_batches) * self.batch_size
+        # cursor math, no data touched: resume repositioning is O(1).
+        # Clamped exactly where sequential next() calls stop (the
+        # increment of the first failing iter_next still lands, then
+        # the generic DataIter.skip breaks on StopIteration): an
+        # unclamped overshoot inflates the cursor past that point, and
+        # roll_over's reset() derives the next epoch's wrap offset from
+        # the cursor — skip(k) must leave the same value k next()s would.
+        target = self.cursor + int(num_batches) * self.batch_size
+        if target >= self.num_data:
+            to_end = -(-(self.num_data - self.cursor) // self.batch_size)
+            target = min(target,
+                         self.cursor + max(1, to_end) * self.batch_size)
+        self.cursor = target
 
     def next(self):
         if self.iter_next():

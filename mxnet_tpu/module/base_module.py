@@ -297,6 +297,41 @@ class BaseModule(object):
                 resume_skip = int(state.get("nbatch", 0))
                 gs0 = int(state.get("global_step", 0))
                 resume_metric = state.get("metric")
+                # -- elastic resume (docs/robustness.md) ---------------
+                # The snapshot is layout-independent (named trees;
+                # _restore_train_state just re-sharded the optimizer
+                # slabs at THIS world's dp), but the iterator cursor
+                # counts batches at the WRITER's global batch. When the
+                # restoring world feeds a different global batch,
+                # translate through the invariant that actually matters:
+                # the global SAMPLE position.
+                topo = state.get("topology")
+                cur = self._topology()
+                if topo and cur:
+                    wgb = int(topo.get("global_batch") or 0)
+                    cgb = int(cur.get("global_batch") or 0)
+                    if wgb and cgb and wgb != cgb:
+                        samples = resume_skip * wgb
+                        resume_skip, rem = divmod(samples, cgb)
+                        if rem:
+                            # round DOWN: re-feeding (<1 batch of) seen
+                            # samples beats silently skipping unseen ones
+                            self.logger.warning(
+                                "elastic resume: sample position %d is "
+                                "not a multiple of the new global batch "
+                                "%d — %d samples will be re-fed",
+                                samples, cgb, rem)
+                        # the saved metric accumulated at the old batch
+                        # geometry; with the cursor translated it still
+                        # covers exactly the samples trained so far
+                    if topo.get("dp") != cur.get("dp"):
+                        self.logger.info(
+                            "elastic resume: checkpoint written at dp=%s "
+                            "(global batch %s), restoring at dp=%s "
+                            "(global batch %s) — optimizer state "
+                            "re-sharded across %s replicas",
+                            topo.get("dp"), wgb or "?", cur.get("dp"),
+                            cgb or "?", cur.get("dp"))
                 ckpt_mgr.last_step = gs0
                 _C_RESUME_LOADED.inc()
                 self.logger.info(
@@ -306,6 +341,40 @@ class BaseModule(object):
         loop = {"gs": gs0, "done": resume_skip, "epoch": begin_epoch,
                 "last_saved": gs0}
         preempt = {"flag": False}
+
+        # -- elastic shrink driver (docs/robustness.md) ----------------
+        # MXTPU_ELASTIC=1 promotes heartbeat liveness from a reporter to
+        # a driver: when a peer replica is declared lost mid-fit
+        # (lost_ tombstone, or a heartbeat that went silent past
+        # MXTPU_ELASTIC_TIMEOUT), drain at the next group boundary,
+        # write a final synchronous checkpoint, and exit EXIT_RESHAPE —
+        # the supervisor (tools/watchdog.py --elastic) relaunches at the
+        # surviving world size, where resume="auto" re-binds the same
+        # named-tree state at the new dp.
+        elastic = None
+        if ckpt_mgr is not None and os.environ.get("MXTPU_ELASTIC") == "1":
+            from ..parallel import heartbeat as _hb
+
+            _run_dir = _hb.run_dir()
+
+            def _env_num(name, default, cast):
+                try:
+                    return cast(os.environ.get(name, default))
+                except ValueError:
+                    return cast(default)
+
+            _world = _env_num(
+                "MXTPU_WORLD_SIZE",
+                os.environ.get("DMLC_NUM_WORKER", "0"), int)
+            if _run_dir and _world > 1:
+                elastic = {
+                    "hb": _hb, "dir": _run_dir, "world": _world,
+                    "rank": _env_num("DMLC_RANK", "0", int),
+                    "poll": _env_num("MXTPU_ELASTIC_POLL", "5", float),
+                    "timeout": _env_num(
+                        "MXTPU_ELASTIC_TIMEOUT", "60", float),
+                    "next": 0.0,
+                }
 
         def _capture(epoch_next, nbatch_done):
             try:
@@ -320,6 +389,7 @@ class BaseModule(object):
                 "metric": metric_blob,
                 "rng": {"numpy": np.random.get_state(),
                         "mx": _rnd.get_state()},
+                "topology": self._topology(),
             }
 
         def _after_steps(epoch, done, n_new):
@@ -349,6 +419,27 @@ class BaseModule(object):
                     "preempted: checkpoint at step %d written, exiting %d",
                     loop["gs"], _ckpt.EXIT_PREEMPTED)
                 raise SystemExit(_ckpt.EXIT_PREEMPTED)
+            if elastic is not None:
+                now = time.monotonic()
+                if now >= elastic["next"]:
+                    elastic["next"] = now + elastic["poll"]
+                    lost = [r for r in elastic["hb"].lost_nodes(
+                                elastic["dir"], elastic["world"],
+                                timeout=elastic["timeout"])
+                            if r != elastic["rank"]]
+                    if lost:
+                        # drain-at-group-boundary, exactly like the
+                        # preemption path: the dispatch frontier is
+                        # behind us, so the snapshot and the iterator
+                        # position agree
+                        _drain_metrics()
+                        ckpt_mgr.save(_capture(epoch, done), loop["gs"])
+                        self.logger.info(
+                            "elastic: replica(s) %s declared lost — "
+                            "checkpoint at step %d written, exiting %d "
+                            "for shrink-and-continue",
+                            lost, loop["gs"], _ckpt.EXIT_RESHAPE)
+                        raise SystemExit(_ckpt.EXIT_RESHAPE)
             if (ckpt_interval
                     and loop["gs"] - loop["last_saved"] >= ckpt_interval):
                 loop["last_saved"] = loop["gs"]
@@ -637,6 +728,13 @@ class BaseModule(object):
         self.set_params(
             {k: nd.array(v) for k, v in (blob.get("arg") or {}).items()},
             {k: nd.array(v) for k, v in (blob.get("aux") or {}).items()})
+
+    def _topology(self):
+        """Checkpoint hook: the runtime topology (dp, mesh, batch
+        geometry) recorded into manifests for elastic resume, or None
+        when this module type has no meaningful topology. Module
+        overrides it."""
+        return None
 
     def _metric_snapshot(self):
         """Deferred-metric hook for fit()'s MXTPU_METRIC_INTERVAL path:

@@ -852,6 +852,38 @@ class Module(BaseModule):
             self._exec_group.get_params(self._arg_params, self._aux_params)
             self._params_dirty = False
 
+    def _topology(self):
+        """The runtime topology this module trains at, recorded into
+        checkpoint manifests (elastic resume): dp degree, mesh axis
+        shape, and batch geometry. The state payload itself is
+        layout-independent — this is the metadata that lets the
+        restoring side rescale its data cursor and lets ckpt_inspect
+        warn about a cross-world restore up front."""
+        if not self.binded:
+            return None
+        global_batch = self._exec_group.batch_size
+        mesh_shape = None
+        if self._fused_trainer is not None:
+            mesh = self._fused_owner._fused_trainer.mesh
+            mesh_shape = {k: int(v) for k, v in mesh.shape.items()}
+            dp = mesh_shape.get("dp", 1)
+            if getattr(self, "_fused_multiproc", False):
+                # each process feeds its local rows; the global batch is
+                # the fleet's (reference dist semantics, _init_optimizer
+                # rescale math)
+                import jax
+
+                global_batch *= max(1, jax.process_count())
+        else:
+            dp = len(self._context)
+        dp = max(1, int(dp))
+        return {
+            "dp": dp,
+            "mesh": mesh_shape,
+            "global_batch": int(global_batch),
+            "per_replica_batch": int(global_batch) // dp,
+        }
+
     def _capture_train_state(self):
         """Consistent snapshot of params + optimizer state for the atomic
         checkpointer (resilience/checkpoint.py).

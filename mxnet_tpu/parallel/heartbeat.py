@@ -62,6 +62,16 @@ _G_PROG_AGE = _tm.gauge(
 RUN_DIR_ENV = "MXTPU_RUN_DIR"
 _HB_PREFIX = "hb_"
 _PROG_PREFIX = "prog_"
+# Tombstones: an external controller (or resilience/fault.py's
+# replica_lost / heartbeat_stall directives — which replicate these
+# file names to stay stdlib-standalone) declares a rank gone by
+# dropping ``lost_<rank>`` / ``stall_<rank>`` into the run dir. Writers
+# honor them (a tombstoned rank stops beating / reporting progress) and
+# lost_nodes() treats a lost tombstone as immediately dead — no need to
+# wait out the staleness timeout, which keeps elastic-shrink tests
+# deterministic.
+_LOST_PREFIX = "lost_"
+_STALL_PREFIX = "stall_"
 
 
 def run_dir():
@@ -74,6 +84,42 @@ def _touch(path):
     with open(path, "a"):
         pass
     os.utime(path, None)
+
+
+def _tombstone(directory, prefix, rank):
+    return os.path.join(directory, "%s%d" % (prefix, int(rank)))
+
+
+def mark_lost(directory, rank, stall_only=False):
+    """Declare ``rank`` lost (or, with ``stall_only``, progress-wedged):
+    drop the tombstone and back-date the corresponding signal file so
+    pollers trip on their next pass regardless of timeout. This is the
+    controller-side half of the elastic contract; the passive half is
+    that this rank's own HeartbeatWriter stops touching the file."""
+    prefixes = ((_STALL_PREFIX, _PROG_PREFIX) if stall_only
+                else (_LOST_PREFIX, _HB_PREFIX))
+    _touch(_tombstone(directory, prefixes[0], rank))
+    stale = os.path.join(directory, "%s%d" % (prefixes[1], int(rank)))
+    with open(stale, "a"):
+        pass
+    os.utime(stale, (1.0, 1.0))
+
+
+def tombstoned(directory):
+    """Ranks with a ``lost_<rank>`` tombstone in the run dir (what
+    tools/watchdog.py --elastic reads to size the restart world)."""
+    ranks = set()
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return ranks
+    for name in entries:
+        if name.startswith(_LOST_PREFIX):
+            try:
+                ranks.add(int(name[len(_LOST_PREFIX):]))
+            except ValueError:
+                pass
+    return ranks
 
 
 class HeartbeatWriter:
@@ -92,7 +138,18 @@ class HeartbeatWriter:
         self._thread = None
         self._last_prog = 0.0
         self._last_ticks = 0
+        self._lost = False  # sticky once the tombstone is seen
         os.makedirs(directory, exist_ok=True)
+
+    def _is_lost(self):
+        """A ``lost_<rank>`` tombstone silences this writer for good:
+        fault injection (replica_lost) simulates a vanished replica by
+        freezing its heartbeat, and a writer that kept re-touching the
+        back-dated file would un-kill it every interval."""
+        if not self._lost:
+            self._lost = os.path.exists(
+                _tombstone(self._dir, _LOST_PREFIX, self.rank))
+        return self._lost
 
     def start(self):
         if self._thread is not None:
@@ -137,6 +194,9 @@ class HeartbeatWriter:
         now = time.monotonic()
         if ticks <= 1 and now - self._last_prog < self._interval:
             return
+        if self._is_lost() or os.path.exists(
+                _tombstone(self._dir, _STALL_PREFIX, self.rank)):
+            return  # tombstoned: the rank must LOOK wedged to pollers
         per_tick = 0.0
         if self._last_prog > 0.0 and self._last_ticks > 0:
             per_tick = max(0.0, now - self._last_prog) / self._last_ticks
@@ -154,6 +214,8 @@ class HeartbeatWriter:
     def _beat(self):
         # liveness is the file's mtime (all dead_nodes reads); touch is
         # cheaper and atomic vs the readers, no payload needed
+        if self._is_lost():
+            return
         _touch(self._path)
 
     def _loop(self):
@@ -219,3 +281,27 @@ def stalled_nodes(directory, num_workers, timeout, now=None):
         if age > timeout:
             stalled.append(rank)
     return stalled
+
+
+def lost_nodes(directory, num_workers, timeout=60.0, now=None):
+    """Ranks declared LOST for elastic-shrink purposes: a ``lost_``
+    tombstone, or a heartbeat file that exists but is stale past
+    ``timeout``.
+
+    Deliberately stricter than :func:`dead_nodes`: a rank that never
+    wrote a heartbeat is a launcher/startup problem (watchdog
+    startup_timeout territory), not a shrink signal — treating it as
+    lost would shrink a healthy fleet that is still compiling. Only a
+    rank that was seen alive and then went silent (or was explicitly
+    tombstoned) votes for a smaller world."""
+    now = time.time() if now is None else now
+    lost = tombstoned(directory)
+    for rank in range(int(num_workers)):
+        path = os.path.join(directory, "%s%d" % (_HB_PREFIX, rank))
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue  # never started: not a shrink vote
+        if age > timeout:
+            lost.add(rank)
+    return sorted(r for r in lost if 0 <= r < int(num_workers))

@@ -63,14 +63,35 @@ def device_count():
     return jax.device_count()
 
 
+#: Elastic world size (tools/watchdog.py --elastic exports it per
+#: attempt): cap the mesh to the first N devices instead of all of
+#: jax.devices(), so a restart after a replica loss can rebuild a
+#: smaller mesh on the same host topology without a new launch config.
+ENV_WORLD = "MXTPU_WORLD_SIZE"
+
+
+def world_size(default=0):
+    """The supervisor-imposed world size, or ``default`` when unset or
+    malformed. 0 means "use every visible device"."""
+    try:
+        return max(0, int(os.environ.get(ENV_WORLD, default)))
+    except (TypeError, ValueError):
+        return max(0, int(default))
+
+
 def make_mesh(dp=None, tp=1, pp=1, sp=1, ep=1, devices=None):
     """Create a Mesh with axes (dp, tp, pp, sp, ep). dp defaults to
-    whatever is left after tp*pp*sp*ep."""
+    whatever is left after tp*pp*sp*ep. With ``devices=None`` the mesh
+    spans ``jax.devices()``, truncated to :data:`ENV_WORLD` when the
+    supervisor imposed an elastic world size."""
     import jax
     from jax.sharding import Mesh
 
     if devices is None:
         devices = jax.devices()
+        world = world_size()
+        if world:
+            devices = devices[:min(world, len(devices))]
     n = len(devices)
     if dp is None:
         assert n % (tp * pp * sp * ep) == 0, (

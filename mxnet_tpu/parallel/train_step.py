@@ -334,10 +334,45 @@ class ShardedTrainStep:
 
         state = {}
         for bi, b in enumerate(plan.buckets):
-            parts = [named[name] for (_i, name, _o, _s, _sh) in b.views]
+            try:
+                parts = [named[name] for (_i, name, _o, _s, _sh) in b.views]
+            except KeyError as exc:
+                raise KeyError(
+                    "optimizer state for param %s missing from the named "
+                    "snapshot — the checkpoint does not match this "
+                    "symbol's parameter set" % (exc,))
             state[self._flat_key(bi)] = _pack(
                 parts, b.padded - b.size, b.dtype)
         return state
+
+    def opt_state_shard_info(self, opt_state):
+        """(total_elements, resident_elements) across the optimizer
+        state tree, where *resident* counts what THIS process's first
+        addressable device actually materializes. The 1/N-memory claim
+        of the sharded update is exactly ``resident ≈ total / dp`` —
+        tests at each elastic world size assert on this surface instead
+        of groping at device allocator stats."""
+        total = 0
+        resident = 0
+
+        def _walk(leaf):
+            nonlocal total, resident
+            if leaf is None:
+                return
+            if isinstance(leaf, tuple):
+                for part in leaf:
+                    _walk(part)
+                return
+            total += int(leaf.size)
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                resident += int(shards[0].data.size)
+            else:
+                resident += int(leaf.size)
+
+        for leaf in (opt_state or {}).values():
+            _walk(leaf)
+        return total, resident
 
     def disable_flat_update(self, opt_state):
         """Demote to the legacy per-param update (borrow_optimizer /

@@ -18,7 +18,7 @@ crash-resume integration suite (``MXTPU_FAULT_INJECT``).
 """
 from . import checkpoint, fault, retry  # noqa: F401
 from .checkpoint import (  # noqa: F401
-    EXIT_PREEMPTED, CheckpointError, CheckpointManager, atomic_file,
-    list_checkpoints, load_state, verify_checkpoint,
+    EXIT_PREEMPTED, EXIT_RESHAPE, CheckpointError, CheckpointManager,
+    atomic_file, list_checkpoints, load_state, verify_checkpoint,
 )
 from .retry import TransientError, is_retryable  # noqa: F401

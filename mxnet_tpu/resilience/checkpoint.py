@@ -57,6 +57,12 @@ except ImportError:  # standalone import (tools/ckpt_inspect.py by path)
 #: real training failure.
 EXIT_PREEMPTED = 75
 
+#: Exit code for "a replica was declared lost, final checkpoint written,
+#: restart me at the surviving world size". Distinct from EXIT_PREEMPTED
+#: so supervisors know a same-size retry would hang on the dead rank:
+#: ``tools/watchdog.py --elastic`` answers by shrinking MXTPU_WORLD_SIZE.
+EXIT_RESHAPE = 76
+
 ENV_INTERVAL = "MXTPU_CKPT_INTERVAL"
 ENV_KEEP = "MXTPU_CKPT_KEEP"
 
@@ -401,6 +407,13 @@ class CheckpointManager:
             "files": files,
             "tensors": tensors,
         }
+        # The writer's runtime topology (dp degree, mesh shape, batch
+        # geometry) rides in the manifest so inspection tools can warn
+        # about a cross-world restore BEFORE the restoring process gets
+        # an opaque shape error. Informational only: the state payload
+        # itself is named-tree / layout-independent by design.
+        if state.get("topology"):
+            manifest["topology"] = state["topology"]
         payload = json.dumps(manifest, indent=1, sort_keys=True).encode()
         _write_member(tmp, MANIFEST, payload)
         return sum(m["bytes"] for m in files.values()) + len(payload)

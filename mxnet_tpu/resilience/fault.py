@@ -36,11 +36,26 @@ Directives (value is always an integer):
 ``fail_recordio_read=N`` First N recordio reads raise transient EIO.
 ``fail_kv_push=N``       First N kvstore push bodies raise transient EIO.
 ``fail_kv_pull=N``       First N kvstore pull bodies raise transient EIO.
+``replica_lost=R@K``     At step K, declare rank R lost: ``lost_R``
+                         tombstone + back-dated ``hb_R`` in MXTPU_RUN_DIR
+                         (that rank's HeartbeatWriter goes silent for
+                         good), and if THIS process is rank R (DMLC_RANK)
+                         it vanishes from subsequent host collectives —
+                         the elastic shrink trigger, deterministic like
+                         kill_at_step.
+``heartbeat_stall=R@K``  At step K, freeze rank R's PROGRESS mark only
+                         (``stall_R`` tombstone + back-dated ``prog_R``):
+                         the alive-but-wedged-in-a-collective signature
+                         stalled_nodes()/--progress-timeout catch.
 =======================  ====================================================
 
-Counters are per-process and keyed by the raw spec string, so a
-monkeypatched spec in tests starts fresh. Stdlib-only and importable
-standalone (tools and subprocess test scripts load it by path).
+Values are integers except ``replica_lost``/``heartbeat_stall``, whose
+``<rank>@<step>`` pairs parse to (rank, step) tuples; malformed values
+are still ignored. Counters are per-process and keyed by the raw spec
+string, so a monkeypatched spec in tests starts fresh. Stdlib-only and
+importable standalone (tools and subprocess test scripts load it by
+path) — which is why the run-dir file names it shares with
+parallel/heartbeat.py are replicated here instead of imported.
 """
 from __future__ import annotations
 
@@ -76,7 +91,13 @@ def _spec():
             try:
                 spec[key.strip()] = int(val)
             except ValueError:
-                pass  # malformed directive: ignore, never crash the host
+                if "@" in val:  # <rank>@<step> pair (replica_lost & co)
+                    rank, _, step = val.partition("@")
+                    try:
+                        spec[key.strip()] = (int(rank), int(step))
+                    except ValueError:
+                        pass
+                # else malformed directive: ignore, never crash the host
         _parse_cache[raw] = spec
     return raw, spec
 
@@ -113,6 +134,14 @@ def fire(point, **ctx):
             os._exit(77)
         if spec.get("preempt_at_step") == step and _take(raw, "preempt", 1):
             os.kill(os.getpid(), signal.SIGTERM)
+        rl = spec.get("replica_lost")
+        if (isinstance(rl, tuple) and rl[1] == step
+                and _take(raw, "replica_lost", 1)):
+            _mark_rank(rl[0], stall_only=False)
+        hs = spec.get("heartbeat_stall")
+        if (isinstance(hs, tuple) and hs[1] == step
+                and _take(raw, "heartbeat_stall", 1)):
+            _mark_rank(hs[0], stall_only=True)
     elif point == "ckpt_write":
         n = spec.get("enospc_at_ckpt_write")
         if n is not None:
@@ -132,6 +161,15 @@ def fire(point, **ctx):
         ms = spec.get("delay_collective_ms", 0)
         if ms > 0:
             time.sleep(ms / 1000.0)
+        rl = spec.get("replica_lost")
+        if (isinstance(rl, tuple) and _fired.get((raw, "replica_lost"))
+                and os.environ.get("DMLC_RANK") == str(rl[0])):
+            # The lost rank drops out of the fleet's collectives: block
+            # here indefinitely, the way a preempted peer would — its
+            # survivors' progress marks go stale and the watchdog (or
+            # fit's elastic guard on the peers) takes it from there.
+            while True:
+                time.sleep(60.0)
     elif point == "recordio_read":
         n = spec.get("fail_recordio_read", 0)
         if n and _take(raw, "fail_recordio_read", n):
@@ -145,6 +183,30 @@ def fire(point, **ctx):
         n = spec.get("fail_kv_pull", 0)
         if n and _take(raw, "fail_kv_pull", n):
             raise _transient("kv pull key=%s" % ctx.get("key"))
+
+
+_RUN_DIR_ENV = "MXTPU_RUN_DIR"
+
+
+def _mark_rank(rank, stall_only):
+    """File-level mirror of parallel/heartbeat.py ``mark_lost``
+    (names replicated so this module stays stdlib-standalone): drop the
+    tombstone and back-date the signal file so liveness pollers trip on
+    their very next pass — no waiting out a staleness timeout."""
+    directory = os.environ.get(_RUN_DIR_ENV)
+    if not directory:
+        return  # no run dir: nothing is polling liveness anyway
+    tomb, sig = ("stall_", "prog_") if stall_only else ("lost_", "hb_")
+    try:
+        os.makedirs(directory, exist_ok=True)
+        for prefix, backdate in ((tomb, False), (sig, True)):
+            path = os.path.join(directory, "%s%d" % (prefix, int(rank)))
+            with open(path, "a"):
+                pass
+            if backdate:
+                os.utime(path, (1.0, 1.0))
+    except OSError:
+        pass  # injection is best-effort; never crash the host
 
 
 def _truncate_params(ckpt_path):

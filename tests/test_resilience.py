@@ -352,6 +352,45 @@ def test_ndarrayiter_skip_is_cursor_math():
                                   x[6:8])
 
 
+@pytest.mark.parametrize("mode", ["pad", "discard", "roll_over"])
+def test_ndarrayiter_skip_matches_sequential_all_modes(mode):
+    """skip(k) must leave the iterator exactly where k sequential
+    next() calls would — cursor, remaining stream, AND the next epoch
+    after reset() (roll_over derives its wrap offset from the cursor,
+    so an overshooting skip corrupts epoch 2 silently)."""
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+
+    def make():
+        it = mx.io.NDArrayIter(x, np.zeros(10, np.float32), batch_size=3,
+                               last_batch_handle=mode)
+        it.reset()
+        return it
+
+    def drain(it):
+        out = []
+        while it.iter_next():
+            out.append(np.asarray(it.getdata()[0].asnumpy()))
+        return out
+
+    for k in range(0, 8):
+        skipped, walked = make(), make()
+        skipped.skip(k)
+        for _ in range(k):
+            if not walked.iter_next():
+                break
+        assert skipped.cursor == walked.cursor, (mode, k)
+        rest_s, rest_w = drain(skipped), drain(walked)
+        assert len(rest_s) == len(rest_w), (mode, k)
+        for a, b in zip(rest_s, rest_w):
+            np.testing.assert_array_equal(a, b)
+        # epoch 2: reset() must compute the same wrap offset
+        skipped.reset()
+        walked.reset()
+        assert skipped.cursor == walked.cursor, (mode, k)
+        for a, b in zip(drain(skipped), drain(walked)):
+            np.testing.assert_array_equal(a, b)
+
+
 def test_devicefeed_iter_skip_matches_sequential(tmp_path):
     import jax
 

@@ -176,6 +176,34 @@ def test_ckpt_inspect_cli_self_test():
     assert "self-test passed" in res.stdout
 
 
+def test_watchdog_cli_self_test():
+    """Elastic restart decision table + stub-job supervision end to end
+    (dead rank -> shrink, exit-75 -> same-size retry, exhausted budget
+    -> fail)."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.watchdog", "--self-test"],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "self-test passed" in res.stdout
+
+
+def test_watchdog_decision_table_rows():
+    from tools import watchdog
+
+    # the three ISSUE rows, pinned here as well as in --self-test
+    assert watchdog.decide(
+        watchdog.EXIT_RESHAPE, [3], 0, 2, 8, True) == ("shrink", 7)
+    assert watchdog.decide(
+        watchdog.EXIT_PREEMPTED, [], 0, 2, 8, True) == ("retry", 8)
+    assert watchdog.decide(1, [], 2, 2, 8, True) == ("fail", 8)
+    # shrink is budget-free; elastic off never shrinks
+    assert watchdog.decide(
+        watchdog.EXIT_RESHAPE, [3], 2, 2, 8, True) == ("shrink", 7)
+    assert watchdog.decide(
+        watchdog.EXIT_RESHAPE, [3], 2, 2, 8, False) == ("fail", 8)
+
+
 def test_perf_doctor_cli_self_test():
     repo = os.path.join(os.path.dirname(__file__), "..")
     res = subprocess.run(

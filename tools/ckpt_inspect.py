@@ -39,9 +39,42 @@ def _dir_bytes(path):
     return total
 
 
-def list_dir(directory, deep=False):
+def _topology_str(manifest):
+    topo = manifest.get("topology")
+    if not topo:
+        return None
+    return "dp=%s global_batch=%s per_replica_batch=%s mesh=%s" % (
+        topo.get("dp"), topo.get("global_batch"),
+        topo.get("per_replica_batch"), topo.get("mesh"))
+
+
+def topology_warnings(manifest, expect_dp=None, expect_batch=None):
+    """Cross-world restore preflight: WARNINGS (never failures — the
+    state format is layout-independent, so a dp/batch mismatch means an
+    elastic resume, not a corrupt checkpoint) when the writer's recorded
+    topology differs from what the restoring world expects."""
+    topo = manifest.get("topology") or {}
+    warnings = []
+    if expect_dp is not None and topo.get("dp") not in (None, expect_dp):
+        warnings.append(
+            "WARNING: written at dp=%s but restoring world expects "
+            "dp=%s — optimizer slabs will be re-sharded on resume "
+            "(not bitwise vs the writer's world)"
+            % (topo.get("dp"), expect_dp))
+    if (expect_batch is not None
+            and topo.get("global_batch") not in (None, expect_batch)):
+        warnings.append(
+            "WARNING: written at global batch %s but restoring world "
+            "expects %s — the data cursor will be rescaled by global "
+            "sample position on resume"
+            % (topo.get("global_batch"), expect_batch))
+    return warnings
+
+
+def list_dir(directory, deep=False, expect_dp=None, expect_batch=None):
     """(lines, n_bad) listing every checkpoint and its verification
-    status; ``deep`` re-hashes tensors too."""
+    status; ``deep`` re-hashes tensors too. ``expect_dp`` /
+    ``expect_batch`` append cross-world restore warnings."""
     lines = []
     bad = 0
     steps = ck.list_checkpoints(directory)
@@ -52,9 +85,14 @@ def list_dir(directory, deep=False):
         try:
             manifest = ck.verify_checkpoint(path, deep=deep)
             n_tensors = len(manifest.get("tensors", {}))
-            lines.append("ckpt-%012d  %9d bytes  %3d tensors  OK%s"
+            topo = _topology_str(manifest)
+            lines.append("ckpt-%012d  %9d bytes  %3d tensors  OK%s%s"
                          % (step, _dir_bytes(path), n_tensors,
-                            " (deep)" if deep else ""))
+                            " (deep)" if deep else "",
+                            "  [%s]" % topo if topo else ""))
+            for warning in topology_warnings(
+                    manifest, expect_dp, expect_batch):
+                lines.append("  %s" % warning)
         except ck.CheckpointError as exc:
             bad += 1
             lines.append("ckpt-%012d  CORRUPT: %s" % (step, exc))
@@ -87,6 +125,8 @@ def state_summary(directory, which):
                              if train.get("metric") else "none"),
         "rng        : %s" % ", ".join(sorted(
             (train.get("rng") or {}).keys())),
+        "topology   : %s" % (_topology_str(manifest)
+                             or "not recorded (pre-elastic checkpoint)"),
         "tensors    :",
     ]
     from mxnet_tpu import ndarray as nd
@@ -115,15 +155,27 @@ def _self_test():
         },
         "epoch": 1, "nbatch": 2, "global_step": 10,
         "metric": None, "rng": {"numpy": np.random.get_state()},
+        "topology": {"dp": 4, "mesh": {"dp": 4}, "global_batch": 16,
+                     "per_replica_batch": 4},
     }
     mgr.save(state, 10)
     mgr.save(state, 20)
     lines, bad = list_dir(d, deep=True)
     assert bad == 0 and len(lines) == 2, lines
     assert all("OK" in ln for ln in lines), lines
+    assert all("dp=4" in ln and "global_batch=16" in ln
+               for ln in lines), lines
+
+    # cross-world preflight: mismatches WARN (extra lines), never fail
+    lines, bad = list_dir(d, expect_dp=2, expect_batch=32)
+    assert bad == 0, lines
+    assert sum("WARNING" in ln for ln in lines) == 4, lines
+    lines, bad = list_dir(d, expect_dp=4, expect_batch=16)
+    assert bad == 0 and not any("WARNING" in ln for ln in lines), lines
 
     text = state_summary(d, "latest")
     assert "global_step: 10" in text, text
+    assert "topology   : dp=4" in text, text
     assert "arg:w" in text and "(3, 4)" in text, text
 
     # tear the newest one; the lister must flag it and --state latest
@@ -153,6 +205,13 @@ def main(argv=None):
                              "checkpoint ('latest' or a step number)")
     parser.add_argument("--self-test", action="store_true",
                         help="run built-in checks on synthetic checkpoints")
+    parser.add_argument("--expect-dp", type=int, default=None,
+                        help="warn when a checkpoint's recorded dp degree "
+                             "differs from the restoring world's "
+                             "(elastic-resume preflight; never an error)")
+    parser.add_argument("--expect-batch", type=int, default=None,
+                        help="warn when a checkpoint's recorded global "
+                             "batch differs from the restoring world's")
     args = parser.parse_args(argv)
     if args.self_test:
         return _self_test()
@@ -161,7 +220,9 @@ def main(argv=None):
     if args.state:
         print(state_summary(args.directory, args.state))
         return 0
-    lines, bad = list_dir(args.directory, deep=args.verify)
+    lines, bad = list_dir(args.directory, deep=args.verify,
+                          expect_dp=args.expect_dp,
+                          expect_batch=args.expect_batch)
     print("\n".join(lines))
     return 1 if bad else 0
 

@@ -18,8 +18,17 @@ is re-run as-is and is expected to pick up its latest checkpoint.
 ``find_latest_checkpoint`` is exported for scripts that want automatic
 --load-epoch discovery.
 
+``--elastic`` upgrades restart-at-same-size to shrink-and-continue
+(docs/robustness.md "Elastic resume"): the supervised job runs with
+``MXTPU_ELASTIC=1`` + ``MXTPU_WORLD_SIZE``, and when it exits because a
+replica was declared lost (exit 76, or the watchdog itself observed the
+dead rank / its ``lost_<rank>`` tombstone), the relaunch happens at the
+surviving world size — WITHOUT consuming the restart budget, which stays
+reserved for transient failures (exit 75 preemptions retry same-size).
+
 Usage:
     python tools/watchdog.py --max-restarts 2 -- python train.py ...
+    python tools/watchdog.py --elastic --world 8 -- python train.py ...
 """
 from __future__ import annotations
 
@@ -33,6 +42,12 @@ import subprocess
 import sys
 import tempfile
 import time
+
+# Literal mirrors of resilience/checkpoint.py EXIT_PREEMPTED/EXIT_RESHAPE
+# (sysexits-adjacent contract codes; kept literal so the decision table
+# below reads standalone).
+EXIT_PREEMPTED = 75
+EXIT_RESHAPE = 76
 
 
 def find_latest_checkpoint(prefix):
@@ -66,9 +81,36 @@ def _terminate(proc, grace=15):
         proc.wait()
 
 
+def decide(rc, lost, restarts, max_restarts, world, elastic):
+    """The elastic restart decision table, as a pure function so the
+    self-test (and tests/test_tools.py) can pin every row without
+    spawning processes. Returns ``(action, new_world)`` with action one
+    of ``"done" | "shrink" | "retry" | "fail"``.
+
+    * ``rc == 0`` — done.
+    * elastic, with lost rank(s) and at least one survivor — shrink to
+      the surviving world. Shrinking does NOT consume the restart
+      budget: losing capacity is the expected steady state of a
+      preemptible fleet, and burning the budget on it would turn every
+      shrink into one fewer recovery from a genuinely transient failure.
+    * restart budget remaining — same-size retry (this is the exit-75
+      preemption path, and any other transient crash).
+    * otherwise — fail.
+    """
+    if rc == 0:
+        return ("done", world)
+    lost = set(lost)
+    if elastic and lost and world - len(lost) >= 1:
+        return ("shrink", world - len(lost))
+    if restarts < max_restarts:
+        return ("retry", world)
+    return ("fail", world)
+
+
 def supervise(command, max_restarts=2, num_workers=0,
               heartbeat_timeout=60.0, poll_interval=1.0, run_dir=None,
-              startup_timeout=300.0, progress_timeout=None, log=print):
+              startup_timeout=300.0, progress_timeout=None, elastic=False,
+              world=None, log=print):
     """Run ``command`` under supervision; returns the final exit code
     (0 success, positive failure — signal deaths are normalized to 1 so
     callers see a stable code).
@@ -84,46 +126,74 @@ def supervise(command, max_restarts=2, num_workers=0,
         (``prog_<rank>``) for ``progress_timeout`` seconds. Off by
         default: set it ABOVE the longest legitimate step gap,
         first-compile included.
+
+    ``elastic=True`` (with ``world`` = the initial world size, default
+    ``num_workers``) makes a lost replica shrink the restart world
+    instead of burning the budget: see :func:`decide`.
     """
     from mxnet_tpu.parallel import heartbeat as hb
 
     restarts = 0
     own_run_dir = None
+    if elastic and not world:
+        world = num_workers
+    if elastic and not world:
+        raise ValueError("elastic supervision needs world (or num_workers)")
     while True:
+        mon_workers = world if (elastic and num_workers > 0) else num_workers
         env = dict(os.environ)
-        if num_workers > 0:
+        if mon_workers > 0 or elastic:
             if run_dir is None:
                 run_dir = own_run_dir = tempfile.mkdtemp(
                     prefix="mxtpu_watchdog_")
             os.makedirs(run_dir, exist_ok=True)
-            # fresh staleness baseline per attempt
-            for p in glob.glob(os.path.join(run_dir, "hb_*")) + \
-                    glob.glob(os.path.join(run_dir, "prog_*")):
+            # fresh staleness baseline per attempt; tombstones were read
+            # into the previous attempt's shrink decision, so clearing
+            # them here is what stops one lost rank shrinking every
+            # subsequent restart too
+            for p in (glob.glob(os.path.join(run_dir, "hb_*"))
+                      + glob.glob(os.path.join(run_dir, "prog_*"))
+                      + glob.glob(os.path.join(run_dir, "lost_*"))
+                      + glob.glob(os.path.join(run_dir, "stall_*"))):
                 os.unlink(p)
             env[hb.RUN_DIR_ENV] = run_dir
+        if elastic:
+            # the job sees its (possibly shrunken) world and arms fit's
+            # in-loop shrink driver (module/base_module.py)
+            env["MXTPU_WORLD_SIZE"] = str(world)
+            env["MXTPU_ELASTIC"] = "1"
         # own process group so a stall-kill reaps the launcher's workers
         proc = subprocess.Popen(command, env=env, start_new_session=True)
         started_at = time.time()
         stalled = False
+        lost_seen = set()
         while True:
             rc = proc.poll()
             if rc is not None:
                 break
-            if num_workers > 0:
+            if mon_workers > 0:
                 all_started = not hb.dead_nodes(
-                    run_dir, num_workers, timeout=float("inf"))
+                    run_dir, mon_workers, timeout=float("inf"))
                 reason = None
                 if not all_started:
                     if time.time() - started_at > startup_timeout:
                         reason = ("no heartbeat from every rank within "
                                   "%.0fs of start" % startup_timeout)
-                elif hb.dead_nodes(run_dir, num_workers, heartbeat_timeout):
-                    reason = ("heartbeat stall (> %.0fs)"
-                              % heartbeat_timeout)
-                elif progress_timeout and hb.stalled_nodes(
-                        run_dir, num_workers, progress_timeout):
-                    reason = ("alive but no training progress (> %.0fs) "
-                              "— wedged collective?" % progress_timeout)
+                else:
+                    dead = hb.dead_nodes(run_dir, mon_workers,
+                                         heartbeat_timeout)
+                    if dead:
+                        reason = ("heartbeat stall (> %.0fs)"
+                                  % heartbeat_timeout)
+                        if elastic and len(dead) < mon_workers:
+                            # a strict subset went silent: that is a
+                            # lost-replica vote, not a wholesale hang
+                            lost_seen.update(dead)
+                    elif progress_timeout and hb.stalled_nodes(
+                            run_dir, mon_workers, progress_timeout):
+                        reason = ("alive but no training progress "
+                                  "(> %.0fs) — wedged collective?"
+                                  % progress_timeout)
                 if reason is not None:
                     log("[watchdog] %s: killing job" % reason)
                     _terminate(proc)
@@ -135,7 +205,18 @@ def supervise(command, max_restarts=2, num_workers=0,
             if own_run_dir:
                 shutil.rmtree(own_run_dir, ignore_errors=True)
             return 0
-        if restarts >= max_restarts:
+        lost = []
+        if elastic and run_dir:
+            lost = sorted(hb.tombstoned(run_dir) | lost_seen)
+        action, new_world = decide(rc if not stalled else (rc or 1),
+                                   lost, restarts, max_restarts,
+                                   world or 0, elastic)
+        if action == "shrink":
+            log("[watchdog] elastic shrink: rank(s) %s lost, restarting "
+                "at world %d (was %d)" % (lost, new_world, world))
+            world = new_world
+            continue
+        if action == "fail":
             log("[watchdog] giving up after %d restarts (rc=%s)"
                 % (restarts, rc))
             # minted run dir intentionally left behind: it is the
@@ -144,6 +225,95 @@ def supervise(command, max_restarts=2, num_workers=0,
         restarts += 1
         log("[watchdog] restart %d/%d (rc=%s%s)"
             % (restarts, max_restarts, rc, ", stalled" if stalled else ""))
+
+
+def _self_test():
+    """Pin the elastic restart decision table, then drive supervise()
+    end-to-end with stub jobs (stdlib-only, no jax import)."""
+    # -- decision table -------------------------------------------------
+    assert decide(0, [], 9, 2, 8, True) == ("done", 8)
+    # dead rank -> shrink, budget untouched (even when exhausted)
+    assert decide(EXIT_RESHAPE, [3], 0, 2, 8, True) == ("shrink", 7)
+    assert decide(EXIT_RESHAPE, [3], 2, 2, 8, True) == ("shrink", 7)
+    assert decide(1, [2, 5], 0, 2, 8, True) == ("shrink", 6)
+    assert decide(EXIT_RESHAPE, [3, 3], 0, 2, 8, True) == ("shrink", 7)
+    # transient exit 75 (preemption) -> same-size retry
+    assert decide(EXIT_PREEMPTED, [], 0, 2, 8, True) == ("retry", 8)
+    assert decide(EXIT_PREEMPTED, [], 1, 2, 8, False) == ("retry", 8)
+    # budget exhausted -> fail
+    assert decide(1, [], 2, 2, 8, True) == ("fail", 8)
+    assert decide(EXIT_PREEMPTED, [], 2, 2, 8, False) == ("fail", 8)
+    # every rank lost: nothing to shrink to -> ordinary retry/fail
+    assert decide(EXIT_RESHAPE, list(range(8)), 0, 2, 8, True) == \
+        ("retry", 8)
+    assert decide(EXIT_RESHAPE, list(range(8)), 2, 2, 8, True) == \
+        ("fail", 8)
+    # elastic off: a tombstone changes nothing
+    assert decide(EXIT_RESHAPE, [3], 0, 2, 8, False) == ("retry", 8)
+
+    # -- end-to-end: lose a rank, shrink, finish ------------------------
+    tmp = tempfile.mkdtemp(prefix="mxtpu_watchdog_selftest_")
+    try:
+        script = os.path.join(tmp, "job.py")
+        with open(script, "w") as f:
+            f.write(
+                "import os, sys\n"
+                "world = int(os.environ['MXTPU_WORLD_SIZE'])\n"
+                "assert os.environ.get('MXTPU_ELASTIC') == '1'\n"
+                "marker = sys.argv[1]\n"
+                "if not os.path.exists(marker):\n"
+                "    open(marker, 'w').close()\n"
+                "    assert world == 4, world\n"
+                "    run = os.environ['MXTPU_RUN_DIR']\n"
+                "    open(os.path.join(run, 'lost_2'), 'w').close()\n"
+                "    sys.exit(%d)\n"
+                "assert world == 3, world\n"
+                "sys.exit(0)\n" % EXIT_RESHAPE)
+        msgs = []
+        rc = supervise([sys.executable, script,
+                        os.path.join(tmp, "attempted")],
+                       max_restarts=0, world=4, elastic=True,
+                       run_dir=os.path.join(tmp, "run"),
+                       poll_interval=0.05, log=msgs.append)
+        joined = "\n".join(msgs)
+        assert rc == 0, (rc, joined)
+        assert "elastic shrink" in joined and "world 3" in joined, joined
+
+        # -- end-to-end: transient exit 75 retries same-size ------------
+        script2 = os.path.join(tmp, "job2.py")
+        with open(script2, "w") as f:
+            f.write(
+                "import os, sys\n"
+                "assert os.environ['MXTPU_WORLD_SIZE'] == '4'\n"
+                "marker = sys.argv[1]\n"
+                "if not os.path.exists(marker):\n"
+                "    open(marker, 'w').close()\n"
+                "    sys.exit(%d)\n"
+                "sys.exit(0)\n" % EXIT_PREEMPTED)
+        msgs = []
+        rc = supervise([sys.executable, script2,
+                        os.path.join(tmp, "attempted2")],
+                       max_restarts=1, world=4, elastic=True,
+                       run_dir=os.path.join(tmp, "run2"),
+                       poll_interval=0.05, log=msgs.append)
+        assert rc == 0, (rc, msgs)
+        assert any("restart 1/1" in m for m in msgs), msgs
+
+        # -- end-to-end: budget exhausted fails with the job's rc -------
+        script3 = os.path.join(tmp, "job3.py")
+        with open(script3, "w") as f:
+            f.write("import sys\nsys.exit(7)\n")
+        msgs = []
+        rc = supervise([sys.executable, script3], max_restarts=1,
+                       world=4, elastic=True,
+                       run_dir=os.path.join(tmp, "run3"),
+                       poll_interval=0.05, log=msgs.append)
+        assert rc == 7, (rc, msgs)
+        assert any("giving up" in m for m in msgs), msgs
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("watchdog self-test passed")
+    return 0
 
 
 def main(argv=None):
@@ -158,9 +328,22 @@ def main(argv=None):
                              "progress for this long (catches wedged "
                              "collectives; set above the longest "
                              "legitimate step gap incl. first compile)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="restart at the surviving world size when a "
+                             "replica is lost (exit 76 / lost_<rank> "
+                             "tombstone / observed dead heartbeat) "
+                             "instead of burning the restart budget")
+    parser.add_argument("--world", type=int, default=None,
+                        help="initial world size for --elastic (default: "
+                             "--num-workers)")
+    parser.add_argument("--self-test", action="store_true",
+                        help="run the built-in decision-table and "
+                             "supervision self-test, then exit")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- command to supervise")
     args = parser.parse_args(argv)
+    if args.self_test:
+        sys.exit(_self_test())
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
@@ -169,7 +352,8 @@ def main(argv=None):
     rc = supervise(command, max_restarts=args.max_restarts,
                    num_workers=args.num_workers,
                    heartbeat_timeout=args.heartbeat_timeout,
-                   progress_timeout=args.progress_timeout)
+                   progress_timeout=args.progress_timeout,
+                   elastic=args.elastic, world=args.world)
     sys.exit(rc)
 
 

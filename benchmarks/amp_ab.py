@@ -1,0 +1,227 @@
+"""Mixed-precision (bf16 AMP) vs fp32 A/B harness (ISSUE 8 bench).
+
+Both legs drive the SAME MLP and SGD-momentum math through the fused
+sharded update (MXTPU_SHARD_UPDATE=1, the PR 5 winner):
+
+* ``fp32_sharded`` — the PR 5/6 baseline: fp32 params, fp32 grads,
+  fp32 collectives.
+* ``amp_bf16`` — MXTPU_AMP=bf16: bf16 forward/backward/collectives,
+  fp32 master weights in the flat slabs, dynamic loss scaling, bf16
+  weight all-gather.
+
+Metrics per leg:
+
+* ``update_host_ms`` — wall ms of the jitted update-only program
+  (unscale + master update + state update + bf16 cast-out + weight
+  all-gather for AMP; the fp32 flat update + all-gather for the
+  baseline).
+* ``step_ms`` / ``images_per_sec`` — full fwd+bwd+update step.
+* ``comm_bytes_per_step`` + ``comm_bytes_by_dtype`` — ring-model wire
+  bytes of every collective in the compiled FULL step's HLO, split by
+  element type (the "half-precision collectives" claim is checked here:
+  AMP moves its gradient+weight payloads as bf16, ~0.5x the baseline's
+  f32 bytes).
+* ``final_acc`` — convergence gate: both legs fit the same workload for
+  the same epochs; AMP must land within ``acc_tolerance`` of fp32.
+
+CPU caveat recorded in the result: XLA emulates bf16 arithmetic on
+host (upcast-compute-downcast), so compute-side speedups are
+understated vs TPU; the byte ratios are exact properties of the HLO.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.sharded_ab import (  # noqa: E402
+    _COLL_RE, _ITEM, _median_ms, _mlp, hlo_collective_wire_bytes)
+
+
+def hlo_collective_bytes_by_dtype(hlo_text, n_dev):
+    """Ring-model wire bytes per device, keyed by HLO element type."""
+    by_dtype = {}
+    for m in _COLL_RE.finditer(hlo_text):
+        dt, shp, op = m.groups()
+        n = int(np.prod([int(x) for x in shp.split(",")])) if shp else 1
+        factor = (2.0 if op == "all-reduce" else 1.0) * (n_dev - 1) / n_dev
+        by_dtype[dt] = by_dtype.get(dt, 0.0) + n * _ITEM[dt] * factor
+    return {k: int(v) for k, v in sorted(by_dtype.items())}
+
+
+def _build_trainer(net, ndev, batch, in_dim, amp):
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.parallel import ShardedTrainStep
+    from jax.sharding import Mesh
+
+    os.environ["MXTPU_SHARD_UPDATE"] = "1"
+    if amp:
+        os.environ["MXTPU_AMP"] = "bf16"
+    else:
+        os.environ.pop("MXTPU_AMP", None)
+    mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
+    o = opt.create("sgd", learning_rate=0.01, momentum=0.9,
+                   rescale_grad=1.0 / batch)
+    trainer = ShardedTrainStep(net, mesh, optimizer=o).compile()
+    shapes = {"data": (batch, in_dim), "softmax_label": (batch,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    shapes_by_name = dict(zip(net.list_arguments(), arg_shapes))
+    np.random.seed(0)
+    params, aux, state = trainer.init(shapes_by_name,
+                                      mx.initializer.Uniform(0.05))
+    return trainer, params, aux, state
+
+
+def _leg(net, ndev, batch, in_dim, amp, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    trainer, params, aux, state = _build_trainer(
+        net, ndev, batch, in_dim, amp)
+    assert trainer.amp == amp
+    rng = np.random.RandomState(1)
+    # grads arrive REPLICATED in the real step (post-psum), so place
+    # them that way here too — otherwise the timed update program
+    # includes a broadcast the step phase never pays
+    rep = NamedSharding(trainer.mesh, P())
+    grads = {k: jax.device_put(
+        rng.randn(*v.shape).astype(np.asarray(v).dtype), rep)
+        for k, v in params.items()}
+    lr = jnp.asarray(0.01, jnp.float32)
+    t = jnp.asarray(1.0, jnp.float32)
+
+    apply_fn = (trainer._apply_optimizer_flat_amp if amp
+                else trainer._apply_optimizer_flat)
+    upd = jax.jit(lambda p, g, s: apply_fn(p, g, s, lr, t))
+    out = upd(params, grads, state)  # compile + warm
+    jax.block_until_ready(out)
+    upd_ms = _median_ms(lambda: upd(params, grads, state)[0],
+                        reps, jax.block_until_ready)
+
+    X = rng.randn(batch, in_dim).astype(np.float32)
+    y = rng.randint(0, 10, batch).astype(np.float32)
+    batch_arrs = {
+        "data": jax.device_put(X, trainer.batch_sharding()),
+        "softmax_label": jax.device_put(y, trainer.batch_sharding()),
+    }
+    params, aux, state, _ = trainer(params, aux, state, batch_arrs, t=1)
+    lowered = jax.jit(trainer._make_step_fn()).lower(
+        params, aux, state, batch_arrs, jnp.zeros((2,), jnp.uint32),
+        lr, t)
+    hlo = lowered.compile().as_text()
+    wire, _ops = hlo_collective_wire_bytes(hlo, ndev)
+    by_dtype = hlo_collective_bytes_by_dtype(hlo, ndev)
+
+    holder = [params, aux, state]
+
+    def full():
+        p, a, s, _ = trainer(holder[0], holder[1], holder[2],
+                             batch_arrs, t=2)
+        holder[0], holder[1], holder[2] = p, a, s
+        return p
+
+    full()
+    step_ms = _median_ms(full, reps, jax.block_until_ready)
+    return {
+        "amp": amp,
+        "update_host_ms": round(upd_ms, 3),
+        "step_ms": round(step_ms, 3),
+        "images_per_sec": round(1000.0 * batch / step_ms, 1),
+        "comm_bytes_per_step": int(wire),
+        "comm_bytes_by_dtype": by_dtype,
+    }
+
+
+def _fit_acc(amp, ndev, num_epoch=3):
+    """Convergence gate leg: same data/seeds/epochs through the Module
+    fit path; returns final train accuracy."""
+    import mxnet_tpu as mx
+
+    os.environ["MXTPU_SHARD_UPDATE"] = "1"
+    if amp:
+        os.environ["MXTPU_AMP"] = "bf16"
+    else:
+        os.environ.pop("MXTPU_AMP", None)
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(42)
+    X = rng.randn(256, 16).astype(np.float32)
+    y = (X[:, :4].sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(ndev)])
+    metric = mx.metric.create("acc")
+    mod.fit(it, eval_metric=metric, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / 32},
+            initializer=mx.init.Uniform(0.1), num_epoch=num_epoch)
+    assert mod._fused_owner._fused_trainer.amp == amp
+    return float(metric.get()[1])
+
+
+def run_amp_ab(ndev=8, batch=256, in_dim=512, n_hidden=512, n_layers=6,
+               reps=10, acc_tolerance=0.05):
+    """fp32-sharded vs bf16-AMP A/B. Returns the BENCH-json fragment."""
+    prev_amp = os.environ.get("MXTPU_AMP")
+    try:
+        net = _mlp(n_hidden=n_hidden, n_layers=n_layers)
+        fp32 = _leg(net, ndev, batch, in_dim, False, reps)
+        amp = _leg(net, ndev, batch, in_dim, True, reps)
+        acc_fp32 = _fit_acc(False, min(ndev, 4))
+        acc_amp = _fit_acc(True, min(ndev, 4))
+    finally:
+        if prev_amp is None:
+            os.environ.pop("MXTPU_AMP", None)
+        else:
+            os.environ["MXTPU_AMP"] = prev_amp
+
+    def _ratio(a, b):
+        return round(a / b, 3) if b else None
+
+    fp32["final_acc"] = acc_fp32
+    amp["final_acc"] = acc_amp
+    return {
+        "workload": "%d-layer MLP (hidden %d), %d virtual cpu devices, "
+                    "sgd-momentum, sharded update" %
+                    (n_layers + 1, n_hidden, ndev),
+        "ndev": ndev,
+        "legs": {"fp32_sharded": fp32, "amp_bf16": amp},
+        "amp_vs_fp32": {
+            "update_time_speedup": _ratio(fp32["update_host_ms"],
+                                          amp["update_host_ms"]),
+            "step_time_speedup": _ratio(fp32["step_ms"], amp["step_ms"]),
+            "images_per_sec_ratio": _ratio(amp["images_per_sec"],
+                                           fp32["images_per_sec"]),
+            "comm_bytes_ratio": _ratio(amp["comm_bytes_per_step"],
+                                       fp32["comm_bytes_per_step"]),
+            "convergence_gate": bool(
+                acc_amp >= acc_fp32 - acc_tolerance),
+        },
+        "notes": "comm bytes are ring-model wire bytes from the "
+                 "compiled full step's HLO (exact, backend-"
+                 "independent); on CPU XLA emulates bf16 arithmetic by "
+                 "upcasting, so compute-side times understate the TPU "
+                 "speedup while the byte ratio is the deployable "
+                 "number.",
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    out = run_amp_ab()
+    print(json.dumps(out, indent=2))

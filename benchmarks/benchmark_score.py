@@ -182,7 +182,8 @@ def main():
                     # the hand count should agree within ~10% on convnets
                     row["flops_xla_vs_analytic"] = round(fx / fa, 4)
                 peak = costmodel.peak_flops_for_kind(
-                    getattr(dev, "device_kind", ""))
+                    getattr(dev, "device_kind", ""),
+                    dtype=row["dtype"])
                 fl = fx or fa
                 if peak and fl:
                     # forward-only MFU at the measured wall rate — the
@@ -218,6 +219,18 @@ def main():
             ndev=ab_dev, batch=16 * ab_dev, in_dim=256, n_hidden=256,
             n_layers=3, reps=3 if SMOKE else 10)
         print(json.dumps(out["sharded_update_ab"]), file=sys.stderr)
+    if os.environ.get("SCORE_AMP", "0") == "1":
+        # ISSUE 8 rider: bf16-AMP vs fp32 A/B over the sharded update
+        # (update+collective time, images/sec, per-dtype collective
+        # bytes, convergence gate) — full size in benchmarks/amp_ab.py
+        from benchmarks.amp_ab import run_amp_ab
+
+        ab_dev = min(8, jax.device_count())
+        out["amp_ab"] = run_amp_ab(
+            ndev=ab_dev, batch=32 * ab_dev, in_dim=512,
+            n_hidden=256 if SMOKE else 512,
+            n_layers=3 if SMOKE else 6, reps=3 if SMOKE else 10)
+        print(json.dumps(out["amp_ab"]), file=sys.stderr)
     tag = os.environ.get("SCORE_TAG", "smoke" if SMOKE else "v5e_r4")
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "results", "benchmark_score_%s.json" % tag)

@@ -119,7 +119,13 @@ class GradBucketer(object):
 
     Flush triggers: accumulated bytes reach the cap; any pull (the pull
     must order after its key's deferred update); barrier / updater
-    change / optimizer-state IO (quiescence points)."""
+    change / optimizer-state IO (quiescence points).
+
+    Dtype-aware: buckets group by the pushed grad dtype and the byte cap
+    counts ACTUAL itemsize (a bf16 model packs 2x the keys per bucket an
+    fp32 model does). ``MXTPU_BUCKET_REDUCE_DTYPE=float32`` upcasts
+    low-precision buckets for the cross-worker sum only — see
+    _bucket_allreduce_apply."""
 
     def __init__(self, bucket_bytes):
         self.bucket_bytes = bucket_bytes
@@ -381,6 +387,18 @@ class KVStore(object):
                     # its error surfaces via raise_pending
                     if "error" not in e.box:
                         flat[off:off + n] = e.box.pop("host").ravel()
+                # MXTPU_BUCKET_REDUCE_DTYPE upcasts a low-precision
+                # bucket for the SUM only (e.g. float32 accumulation of
+                # bf16 grads: a W-worker sum in bf16 loses ~log2(W) of
+                # bf16's 8 mantissa bits). Wire bytes go back up to the
+                # accumulation width; the carve-back below re-casts each
+                # key to its own dtype, so the updater sees the same
+                # dtypes either way.
+                rdt = os.environ.get("MXTPU_BUCKET_REDUCE_DTYPE")
+                if rdt:
+                    rdt = _np.dtype(rdt)
+                    if rdt != dtype:
+                        flat = flat.astype(rdt)
                 _H_BUCKET_BYTES.observe(flat.nbytes, path="dist")
                 _M_BUCKET_FLUSHES.inc()
                 if two_phase:
@@ -391,7 +409,7 @@ class KVStore(object):
                     # sharded update uses
                     nproc = jax.process_count()
                     padded = -(-flat.size // nproc) * nproc
-                    buf = _np.zeros(padded, dtype=dtype)
+                    buf = _np.zeros(padded, dtype=flat.dtype)
                     buf[:flat.size] = flat
                     shard = _mesh.reduce_scatter_sum(buf)
                     summed = _mesh.all_gather(shard)[:flat.size]

@@ -9,9 +9,6 @@ flagship for the multi-chip dryrun and the long-context benchmark.
 """
 from __future__ import annotations
 
-import functools
-import os
-
 import numpy as np
 
 
@@ -78,28 +75,12 @@ def transformer_lm(vocab=32000, d_model=512, n_heads=8, n_layers=4,
         q = (x @ p["wq"].astype(dtype)).reshape(B, T, n_heads, head_dim)
         k = (x @ p["wk"].astype(dtype)).reshape(B, T, n_heads, head_dim)
         v = (x @ p["wv"].astype(dtype)).reshape(B, T, n_heads, head_dim)
-        if mesh is not None and mesh.shape.get("sp", 1) > 1:
-            from ..parallel.ring_attention import sequence_parallel_attention
+        # ring (sp>1 mesh) / Pallas flash / reference selection lives in
+        # one place now — ops.pallas_kernels.attention — shared with the
+        # LSTM attention readout (models/lstm.py)
+        from ..ops.pallas_kernels import attention as attn_dispatch
 
-            o = sequence_parallel_attention(q, k, v, mesh, causal=True)
-        elif mesh is None and (
-            os.environ.get("MXNET_TPU_FORCE_FLASH") == "1"
-            or (jax.default_backend() == "tpu" and T >= 128)
-        ):
-            # pallas_call has no GSPMD partition rules: only take the flash
-            # path when not under a sharded mesh (the sp>1 ring path above
-            # composes sharding via shard_map instead)
-            # Pallas flash kernel: O(T·block) memory instead of the
-            # materialized [B,H,T,T] score tensor. MXNET_TPU_FORCE_FLASH=1
-            # routes here off-TPU too (Pallas interpreter) so the wiring is
-            # testable without hardware.
-            from ..ops.pallas_kernels import flash_attention
-
-            o = flash_attention(q, k, v, causal=True)
-        else:
-            from ..ops.pallas_kernels import reference_attention
-
-            o = reference_attention(q, k, v, causal=True)
+        o = attn_dispatch(q, k, v, causal=True, mesh=mesh)
         return o.reshape(B, T, D) @ p["wo"].astype(dtype)
 
     def apply_fn(params, tokens, mesh=None):

@@ -505,6 +505,12 @@ class Module(BaseModule):
             self._arg_params, self._aux_params
         )
         self._fused_opt = self._fused_trainer.make_state(self._fused_params)
+        if self._fused_trainer.amp:
+            # make_state captured the fp32 params as master slabs; the
+            # compiled step now consumes bf16 working copies (invariant:
+            # working params == bf16(masters) at every step boundary)
+            self._fused_params = self._fused_trainer.amp_cast_params(
+                self._fused_params)
         self._fused_t = 0
         self._fused_exec_stale = False
 
@@ -568,6 +574,13 @@ class Module(BaseModule):
                 # dict; the flat slabs span the owner's full param space
                 # and cannot express that — demote the owner to the
                 # legacy per-param update (state converted in place)
+                if owner._fused_trainer.amp:
+                    # the legacy path has no master slabs: reconstitute
+                    # the fp32 truth as the working params before the
+                    # masters are dropped with the flat state
+                    owner._fused_params = (
+                        owner._fused_trainer.master_params_placed(
+                            owner._fused_opt))
                 owner._fused_opt = owner._fused_trainer.disable_flat_update(
                     owner._fused_opt)
                 owner._fused_trainer.compile()
@@ -841,7 +854,15 @@ class Module(BaseModule):
         with _tm.span("module.sync_params"):
             if self._fused_trainer is not None:
                 owner = self._fused_owner
-                for name, arr in owner._fused_params.items():
+                trainer = owner._fused_trainer
+                params_src = owner._fused_params
+                if trainer.amp:
+                    # the working copies are bf16 casts; the fp32 truth
+                    # lives in the master slabs
+                    params_src = dict(params_src)
+                    params_src.update(
+                        trainer.master_params_named(owner._fused_opt))
+                for name, arr in params_src.items():
                     if name in self._arg_params:
                         self._arg_params[name][:] = np.asarray(arr)
                 for name, arr in owner._fused_aux.items():
@@ -909,18 +930,34 @@ class Module(BaseModule):
             owner = self._fused_owner
             fused_state = dict(owner._fused_opt)
             trainer = owner._fused_trainer
+            arg_src = dict(owner._fused_params)
+            amp_blob = None
             if trainer.flat_mode is not None:
+                if trainer.amp:
+                    # snapshot the fp32 masters as "arg" — the on-disk
+                    # params are always full precision, so an AMP
+                    # checkpoint restores into an fp32 run unchanged
+                    # (and vice versa); the loss-scaler state rides as
+                    # a separate scalar blob
+                    arg_src = trainer.master_params_named(fused_state)
+                    amp_blob = {
+                        "scale": fused_state[trainer.AMP_SCALE_KEY],
+                        "good": fused_state[trainer.AMP_GOOD_KEY],
+                    }
                 # carve flat bucket slabs back to per-param trees so the
                 # snapshot layout never depends on MXTPU_SHARD_UPDATE /
                 # MXTPU_BUCKET_BYTES (device-side slices: fresh buffers,
                 # still no host pull on the train thread)
                 fused_state = trainer.flat_state_to_named(fused_state)
-            return {
-                "arg": _copy(dict(owner._fused_params)),
+            out = {
+                "arg": _copy(arg_src),
                 "aux": _copy(dict(owner._fused_aux)),
                 "opt": {"kind": "fused", "t": owner._fused_t,
                         "state": _copy(fused_state)},
             }
+            if amp_blob is not None:
+                out["opt"]["amp"] = _copy(amp_blob)
+            return out
         arg, aux = self.get_params()
         state = {
             "arg": {k: np.array(v.asnumpy()) for k, v in arg.items()},
@@ -954,6 +991,13 @@ class Module(BaseModule):
             owner._fused_params, owner._fused_aux = (
                 owner._fused_trainer.place_params(
                     self._arg_params, self._aux_params))
+            if owner._fused_trainer.amp:
+                # blob["arg"] is the fp32 truth (masters when saved
+                # under AMP); working copies are its bf16 cast, masters
+                # are rebuilt below in _place_fused_opt_state
+                owner._fused_params = (
+                    owner._fused_trainer.amp_cast_params(
+                        owner._fused_params))
             if self is not owner:
                 self._fused_params = owner._fused_params
                 self._fused_aux = owner._fused_aux
@@ -967,7 +1011,9 @@ class Module(BaseModule):
                     "checkpoint carries fused optimizer state but this "
                     "module trains on the executor path — rebind with a "
                     "device kvstore (or retrain) to resume it")
-            self._place_fused_opt_state(opt["t"], opt["state"])
+            self._place_fused_opt_state(opt["t"], opt["state"],
+                                        amp_blob=opt.get("amp"),
+                                        sync_masters=False)
         elif kind == "updater":
             if self._fused_trainer is not None:
                 raise MXNetError(
@@ -984,6 +1030,20 @@ class Module(BaseModule):
                     "checkpoint carries optimizer state but no updater is "
                     "initialized — call init_optimizer before restoring")
             updater.set_states(opt["bytes"])
+        elif self._fused_trainer is not None:
+            owner = self._fused_owner
+            trainer = owner._fused_trainer
+            if trainer.amp:
+                # params-only blob: under AMP the masters ARE the weight
+                # truth, so leaving them stale would silently resume
+                # from the pre-restore weights — rebuild them from the
+                # just-restored fp32 params (scaler state reset)
+                state = dict(owner._fused_opt)
+                state.update(
+                    trainer.build_amp_master_state(self._arg_params))
+                owner._fused_opt = state
+                if self is not owner:
+                    self._fused_opt = owner._fused_opt
 
     def _fused_opt_host_state(self):
         """Fused optimizer state pulled to host: {"t": int, "state":
@@ -995,7 +1055,10 @@ class Module(BaseModule):
         owner = self._fused_owner
         state = dict(owner._fused_opt)
         trainer = owner._fused_trainer
+        amp_blob = None
         if trainer.flat_mode is not None:
+            if trainer.amp:
+                amp_blob = trainer.amp_state_blob(state)
             state = trainer.flat_state_to_named(state)
 
         def _host(s):
@@ -1005,13 +1068,28 @@ class Module(BaseModule):
                 return tuple(_host(x) for x in s)
             return np.asarray(s)
 
+        if amp_blob is not None:
+            return {"t": owner._fused_t, "amp": amp_blob,
+                    "state": {k: _host(v) for k, v in state.items()}}
         return {"t": owner._fused_t,
                 "state": {k: _host(v) for k, v in state.items()}}
 
-    def _place_fused_opt_state(self, t, state_tree):
+    def _place_fused_opt_state(self, t, state_tree, amp_blob=None,
+                               sync_masters=True):
         """Place a host optimizer-state tree back onto the fused
         trainer's shardings (shared by load_optimizer_states and
-        checkpoint resume)."""
+        checkpoint resume).
+
+        Under AMP the flat state also carries the fp32 master slabs and
+        the loss-scaler scalars, which the per-param ``state_tree``
+        deliberately does not (it must stay dtype-portable). Masters are
+        rebuilt from ``self._arg_params``: checkpoint resume
+        (``sync_masters=False``) restored those from the blob's fp32
+        "arg" payload just before calling here; a standalone
+        load_optimizer_states (``sync_masters=True``) first syncs them
+        from the CURRENT device masters so the rebuilt slabs match the
+        weights the run is actually at. ``amp_blob`` restores the saved
+        loss scale / good-step counter; None starts the scaler fresh."""
         import jax
 
         owner = self._fused_owner
@@ -1028,10 +1106,20 @@ class Module(BaseModule):
 
         owner._fused_t = int(t)
         if trainer.flat_mode is not None:
+            if trainer.amp and sync_masters:
+                # pulls the old masters into self._arg_params before the
+                # flat state (and with it the old masters) is replaced
+                self._sync_params_from_devices()
             # repack the per-param snapshot into this run's flat bucket
             # slabs (pads re-zeroed — they provably stay zero under every
             # elementwise optimizer, so resume is bitwise-exact)
             owner._fused_opt = trainer.named_state_to_flat(state_tree)
+            if trainer.amp:
+                blob = amp_blob or {}
+                owner._fused_opt.update(trainer.build_amp_master_state(
+                    self._arg_params,
+                    scale=blob.get("scale"),
+                    good=blob.get("good", 0.0)))
         else:
             owner._fused_opt = {
                 k: _place(k, v) for k, v in state_tree.items()
@@ -1064,7 +1152,8 @@ class Module(BaseModule):
 
             with open(fname, "rb") as fin:
                 blob = pickle.load(fin)
-            self._place_fused_opt_state(blob["t"], blob["state"])
+            self._place_fused_opt_state(blob["t"], blob["state"],
+                                        amp_blob=blob.get("amp"))
             return
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)

@@ -18,6 +18,7 @@ Layout convention matches ``parallel/ring_attention``: [B, T, H, D].
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,32 @@ _NEG_INF = -1e30
 
 def _use_interpret():
     return jax.default_backend() != "tpu"
+
+
+def _no_x64():
+    """Context manager forcing 32-bit tracing: the framework enables
+    jax_enable_x64 globally (reference float64 NDArray parity) but
+    Mosaic kernels must stay 32-bit. `jax.enable_x64` was removed in
+    jax 0.4.x; `jax.experimental.disable_x64` is the stable spelling."""
+    try:
+        return jax.experimental.disable_x64()
+    except AttributeError:  # pragma: no cover — future jax renames
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def fused_update_enabled():
+    """Whether the fused optimizer-slab kernel replaces the jnp update
+    chain. ``MXTPU_FUSED_UPDATE_KERNEL``: "1" forces it on everywhere
+    (interpret mode off-TPU — the parity tests), "0" forces the jnp
+    reference, unset enables it on TPU only."""
+    v = os.environ.get("MXTPU_FUSED_UPDATE_KERNEL", "")
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    return jax.default_backend() == "tpu"
 
 
 def _pad_to(x, axis, mult):
@@ -232,9 +259,7 @@ def _fwd_call(q3, k3, v3, t_real, scale, causal, block_q, block_k,
         _fwd_kernel, block_q=block_q, block_k=block_k, t_real=t_real,
         scale=scale, causal=causal,
     )
-    # trace under 32-bit mode: the framework enables jax_enable_x64 globally
-    # (reference float64 NDArray parity) but Mosaic kernels must stay 32-bit
-    with jax.enable_x64(False):
+    with _no_x64():
         out, lse = pl.pallas_call(
             kern,
             grid=(bh, nq, nk),
@@ -266,7 +291,7 @@ def _bwd_call(q3, k3, v3, do3, lse, delta, t_real, scale, causal,
     bh, t_pad, d = q3.shape
     nq = t_pad // block_q
     nk = t_pad // block_k
-    with jax.enable_x64(False):
+    with _no_x64():
         dq = pl.pallas_call(
             functools.partial(
                 _bwd_dq_kernel, block_q=block_q, block_k=block_k,
@@ -384,6 +409,192 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                  int(block_k))
     out = out[:, :t]
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def attention(q, k, v, causal=False, scale=None, mesh=None):
+    """Shared attention dispatch for every model that wants fused
+    attention without hand-picking a kernel: sequence-parallel ring
+    attention when the mesh shards the sequence axis, the Pallas flash
+    kernel when it pays (TPU and T >= 128, or forced via
+    ``MXNET_TPU_FORCE_FLASH=1``), the materialized reference otherwise.
+    q/k/v: [B, T, H, D] -> [B, T, H, D]."""
+    t = q.shape[1]
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from ..parallel.ring_attention import sequence_parallel_attention
+
+        return sequence_parallel_attention(q, k, v, mesh, causal=causal)
+    force = os.environ.get("MXNET_TPU_FORCE_FLASH") == "1"
+    on_tpu = jax.default_backend() == "tpu"
+    if mesh is None and (force or (on_tpu and t >= 128)):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return reference_attention(q, k, v, causal=causal, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer-slab kernel (AMP update path, parallel/train_step.py).
+#
+# The flat sharded update applies one elementwise optimizer step to a 1/N
+# contiguous slab of the flattened parameter space. Under AMP that step
+# is a chain of ~10 elementwise HLOs (unscale, clip, wd, state math,
+# finite-select, bf16 cast-out) each of which round-trips the slab
+# through HBM. The kernel below runs the whole chain in one VMEM pass:
+# each grid step streams a (block_rows, 128) tile of every operand in,
+# does the full update in registers, and writes new master weight, new
+# state, and the bf16 weight copy out.
+#
+# The jnp path (`slab_update_reference`) and the kernel share
+# `_slab_update_math`, so kernel-vs-reference parity reduces to the
+# pallas_call plumbing (tiling, padding, SMEM scalars) — which is what
+# the interpret-mode tests pin across 1/2/4/8 simulated devices.
+# ---------------------------------------------------------------------------
+
+_SLAB_LANES = 128
+_SLAB_STATE_SLOTS = {"sgd": 0, "sgd_mom": 1, "adam": 2}
+
+
+def _slab_update_math(kind, w, g, states, lr, inv_scale, finite, *, wd,
+                      rescale_grad, clip_gradient, momentum, beta1, beta2,
+                      epsilon):
+    """One AMP optimizer step on a slab, mirroring optimizer_ops.py
+    (`_prep_grad` + sgd/sgd_mom/adam update) with the AMP extras: grad
+    unscale up front, branchless finite-select at the end, bf16 weight
+    copy out. All math in f32 regardless of grad dtype."""
+    w = w.astype(jnp.float32)
+    g = g.astype(jnp.float32) * inv_scale
+    if rescale_grad != 1.0:
+        g = g * jnp.float32(rescale_grad)
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -jnp.float32(clip_gradient),
+                     jnp.float32(clip_gradient))
+    if wd != 0.0:
+        g = g + jnp.float32(wd) * w
+    if kind == "sgd":
+        new_w = w - lr * g
+        new_states = ()
+    elif kind == "sgd_mom":
+        mom = states[0].astype(jnp.float32)
+        new_mom = jnp.float32(momentum) * mom - lr * g
+        new_w = w + new_mom
+        new_states = (new_mom,)
+    elif kind == "adam":
+        mean = states[0].astype(jnp.float32)
+        var = states[1].astype(jnp.float32)
+        new_mean = beta1 * mean + (1.0 - beta1) * g
+        new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+        new_w = w - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+        new_states = (new_mean, new_var)
+    else:
+        raise ValueError("unknown slab kind %r" % (kind,))
+    keep = finite > jnp.float32(0.5)
+    new_w = jnp.where(keep, new_w, w)
+    new_states = tuple(jnp.where(keep, ns, os_.astype(jnp.float32))
+                       for ns, os_ in zip(new_states, states))
+    return new_w, new_states, new_w.astype(jnp.bfloat16)
+
+
+def _slab_kernel(kind, n_state, scalar_ref, w_ref, g_ref, *refs, wd,
+                 rescale_grad, clip_gradient, momentum, beta1, beta2,
+                 epsilon):
+    state_refs = refs[:n_state]
+    out_w_ref = refs[n_state]
+    out_state_refs = refs[n_state + 1:2 * n_state + 1]
+    out_w16_ref = refs[2 * n_state + 1]
+    lr = scalar_ref[0, 0]
+    inv_scale = scalar_ref[0, 1]
+    finite = scalar_ref[0, 2]
+    new_w, new_states, w16 = _slab_update_math(
+        kind, w_ref[...], g_ref[...],
+        tuple(r[...] for r in state_refs), lr, inv_scale, finite,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient,
+        momentum=momentum, beta1=beta1, beta2=beta2, epsilon=epsilon)
+    out_w_ref[...] = new_w
+    for r, ns in zip(out_state_refs, new_states):
+        r[...] = ns
+    out_w16_ref[...] = w16
+
+
+def _slab_pad_2d(x, rows, block_rows):
+    """(S,) -> (rows_padded, 128), zero-filled."""
+    x2 = jnp.pad(x, (0, rows * _SLAB_LANES - x.shape[0])).reshape(
+        rows, _SLAB_LANES)
+    if rows % block_rows:
+        x2 = jnp.pad(x2, ((0, block_rows - rows % block_rows), (0, 0)))
+    return x2
+
+
+def slab_update_reference(kind, w, g, states, lr, inv_scale, finite, *,
+                          wd, rescale_grad, clip_gradient, momentum=0.0,
+                          beta1=0.9, beta2=0.999, epsilon=1e-8):
+    """The pure-jnp slab update (the XLA path and the kernel's oracle)."""
+    new_w, new_states, w16 = _slab_update_math(
+        kind, w, g, states, jnp.asarray(lr, jnp.float32),
+        jnp.asarray(inv_scale, jnp.float32),
+        jnp.asarray(finite, jnp.float32), wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient, momentum=momentum, beta1=beta1,
+        beta2=beta2, epsilon=epsilon)
+    return new_w, new_states, w16
+
+
+def fused_slab_update(kind, w, g, states, lr, inv_scale, finite, *, wd,
+                      rescale_grad, clip_gradient, momentum=0.0, beta1=0.9,
+                      beta2=0.999, epsilon=1e-8, interpret=None):
+    """AMP optimizer step over a flat slab in one Pallas VMEM pass.
+
+    w: (S,) f32 master shard; g: (S,) grad shard (bf16 under AMP);
+    states: tuple of (S,) f32 state slabs (len per `kind`); lr /
+    inv_scale / finite: traced f32 scalars (finite: 1.0 = apply,
+    0.0 = skip bitwise-cleanly). Static hyperparameters are baked into
+    the kernel. Returns (new_w f32, new_states tuple, w16 bf16), each
+    (S,).
+    """
+    n_state = _SLAB_STATE_SLOTS[kind]
+    assert len(states) == n_state, (kind, len(states))
+    s = w.shape[0]
+    rows = -(-s // _SLAB_LANES)
+    block_rows = 256 if rows >= 256 else (-(-rows // 16) * 16)
+    if interpret is None:
+        interpret = _use_interpret()
+    kern = functools.partial(
+        _slab_kernel, kind, n_state, wd=float(wd),
+        rescale_grad=float(rescale_grad),
+        clip_gradient=float(clip_gradient) if clip_gradient else -1.0,
+        momentum=float(momentum), beta1=float(beta1), beta2=float(beta2),
+        epsilon=float(epsilon))
+    # pads/stacks stay OUTSIDE the 32-bit context: under the global
+    # jax_enable_x64 an outer trace caches their lowered subfunctions
+    # with i64 scalar operands, and re-tracing them under disable_x64
+    # emits i32 signatures for the same cache key — mixed-width
+    # func.call verifier errors. Only the pallas_call itself (whose
+    # Mosaic grid indexing must be 32-bit) runs under _no_x64.
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        jnp.asarray(inv_scale, jnp.float32),
+        jnp.asarray(finite, jnp.float32)]).reshape(1, 3)
+    w2 = _slab_pad_2d(w.astype(jnp.float32), rows, block_rows)
+    g2 = _slab_pad_2d(g, rows, block_rows)
+    st2 = [_slab_pad_2d(st.astype(jnp.float32), rows, block_rows)
+           for st in states]
+    rp = w2.shape[0]
+    grid = (rp // block_rows,)
+    blk = pl.BlockSpec((block_rows, _SLAB_LANES), lambda i: (i, 0))
+    blk16 = pl.BlockSpec((block_rows, _SLAB_LANES), lambda i: (i, 0))
+    with _no_x64():
+        outs = pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[pl.BlockSpec((1, 3), lambda i: (0, 0),
+                                   memory_space=pltpu.SMEM),
+                      blk, blk] + [blk] * n_state,
+            out_specs=[blk] + [blk] * n_state + [blk16],
+            out_shape=[jax.ShapeDtypeStruct((rp, _SLAB_LANES),
+                                            jnp.float32)] * (n_state + 1)
+            + [jax.ShapeDtypeStruct((rp, _SLAB_LANES), jnp.bfloat16)],
+            interpret=interpret,
+        )(scalars, w2, g2, *st2)
+    new_w = outs[0].reshape(-1)[:s]
+    new_states = tuple(o.reshape(-1)[:s] for o in outs[1:n_state + 1])
+    w16 = outs[n_state + 1].reshape(-1)[:s]
+    return new_w, new_states, w16
 
 
 def reference_attention(q, k, v, causal=False, scale=None):

@@ -141,6 +141,13 @@ class Optimizer:
     # (create_state captures the live weight values) stay False.
     elementwise_update = False
 
+    # Name of the fused Pallas slab-update kernel variant
+    # (ops/pallas_kernels.fused_slab_update) the AMP flat-update path may
+    # use for this optimizer: "sgd" (momentum attr picks the mom
+    # variant), "adam", or None to always take the jnp reference path.
+    # Only meaningful when elementwise_update is True.
+    fused_slab_kernel = None
+
     def create_state(self, index, weight):
         return None
 
@@ -177,6 +184,7 @@ class SGD(Optimizer):
     """SGD with momentum — fused sgd_update/sgd_mom_update kernels."""
 
     elementwise_update = True
+    fused_slab_kernel = "sgd"
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
@@ -197,6 +205,8 @@ class SGD(Optimizer):
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (reference optimizer.py:413)."""
+
+    fused_slab_kernel = None  # overrides SGD's: no Nesterov slab kernel
 
     def update(self, index, weight, grad, state):
         lr, wd, g = self._begin_update(index, grad)
@@ -262,6 +272,7 @@ class Adam(Optimizer):
     """Adam — fused adam_update kernel with bias correction via lr_t."""
 
     elementwise_update = True
+    fused_slab_kernel = "adam"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):

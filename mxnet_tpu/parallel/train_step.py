@@ -72,7 +72,7 @@ class _FlatUpdatePlan:
     differentiating), and packs size-capped buckets."""
 
     def __init__(self, param_names, shapes, dtypes, optimizer, dp,
-                 bucket_bytes):
+                 bucket_bytes, comm_itemsize=None):
         groups = {}
         order = []
         for i, name in enumerate(param_names):
@@ -86,7 +86,12 @@ class _FlatUpdatePlan:
         self.buckets = []
         for key in order:
             dtype = key[0]
-            itemsize = np.dtype(dtype).itemsize
+            # the size cap counts bytes as they move on the WIRE: under
+            # AMP the slab dtype is the fp32 master but gradients and the
+            # gathered weight copy travel bf16, so the caller passes
+            # comm_itemsize=2 and MXTPU_BUCKET_BYTES keeps meaning actual
+            # collective payload bytes
+            itemsize = comm_itemsize or np.dtype(dtype).itemsize
             cap = max(1, bucket_bytes // itemsize)
             pending = []
             pending_elems = 0
@@ -237,6 +242,37 @@ class ShardedTrainStep:
                 "MXTPU_BUCKET_BYTES=%d)", self.flat_mode, dp,
                 self.flat_bucket_bytes)
         self._flat_plan = None  # built lazily from placed param shapes
+        # -- bf16 AMP (ISSUE 8 tentpole) --------------------------------
+        # forward/backward in bf16, fp32 master weights living as flat
+        # slabs in opt_state, bf16 gradient + weight collectives, dynamic
+        # loss scaling. Rides the flat update exclusively: the masters
+        # ARE the flat slabs, so AMP without the flat path has nowhere to
+        # keep fp32 truth.
+        amp_req = os.environ.get("MXTPU_AMP", "").lower()
+        self.amp = False
+        if amp_req in ("bf16", "bfloat16"):
+            if self.flat_mode is not None:
+                self.amp = True
+                logging.getLogger(__name__).info(
+                    "AMP: bf16 compute + fp32 master slabs (%s mode)",
+                    self.flat_mode)
+            else:
+                logging.getLogger(__name__).warning(
+                    "MXTPU_AMP=bf16 ignored: requires the flat fused-"
+                    "update path (elementwise optimizer, dp>1, "
+                    "MXTPU_BUCKET_BYTES>0, no tp/zero1)")
+        elif amp_req not in ("", "0", "off", "none", "fp32", "f32",
+                             "float32"):
+            logging.getLogger(__name__).warning(
+                "MXTPU_AMP=%s not understood (only bf16); running fp32",
+                amp_req)
+        self.amp_cast_data = os.environ.get(
+            "MXTPU_AMP_CAST_DATA", "1") != "0"
+        self.amp_scale_init = float(
+            os.environ.get("MXTPU_LOSS_SCALE", str(2.0 ** 15)))
+        self.amp_scale_window = int(
+            os.environ.get("MXTPU_LOSS_SCALE_WINDOW", "2000"))
+        self.amp_scale_max = 2.0 ** 24
 
     # ------------------------------------------------------------------
     def _spec_for(self, name):
@@ -266,13 +302,110 @@ class ShardedTrainStep:
         otherwise maps param name -> state; flat slabs span params)."""
         return "__flat__%d" % bucket_index
 
+    # AMP additions to the opt_state dict: fp32 master weight slab per
+    # bucket (same layout/sharding as the state slabs) plus two
+    # replicated device scalars — the live loss scale and the count of
+    # consecutive finite steps. Living in opt_state means they ride the
+    # K-step scan carry, buffer donation, and checkpointing for free.
+    AMP_SCALE_KEY = "__amp_scale__"
+    AMP_GOOD_KEY = "__amp_good__"
+
+    @staticmethod
+    def _master_key(bucket_index):
+        return "__master__%d" % bucket_index
+
+    def amp_cast_params(self, params):
+        """bf16 working copies of fp32 params (the arrays the forward/
+        backward consumes under AMP); non-f32 entries pass through."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.amp:
+            return params
+        out = {}
+        for n, p in params.items():
+            if p.dtype == jnp.float32:
+                out[n] = jax.device_put(
+                    jnp.asarray(p, jnp.bfloat16), self._sharding_for(n))
+            else:
+                out[n] = p
+        return out
+
+    def build_amp_master_state(self, params_by_name, scale=None,
+                               good=0.0):
+        """Pack full-shape fp32 params into master slabs + the scale
+        scalars. `params_by_name` must be fp32 truth (host or device);
+        `scale`/`good` seed the loss scaler (fresh init by default)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        plan = self._flat_plan
+        assert plan is not None, "flat plan not built yet"
+        sharding = self._flat_state_sharding()
+        state = {}
+        for bi, b in enumerate(plan.buckets):
+            parts = [np.asarray(params_by_name[name],
+                                np.float32).reshape(-1)
+                     for (_i, name, _o, _s, _sh) in b.views]
+            pad = b.padded - b.size
+            if pad:
+                parts.append(np.zeros((pad,), np.float32))
+            state[self._master_key(bi)] = jax.device_put(
+                np.concatenate(parts), sharding)
+        rep = NamedSharding(self.mesh, P())
+        state[self.AMP_SCALE_KEY] = jax.device_put(
+            np.asarray(self.amp_scale_init if scale is None else scale,
+                       np.float32), rep)
+        state[self.AMP_GOOD_KEY] = jax.device_put(
+            np.asarray(good, np.float32), rep)
+        return state
+
+    def master_params_named(self, opt_state):
+        """fp32 master weights carved back to per-param shapes (lazy
+        device slices — the fp32 truth for metrics/checkpoints)."""
+        plan = self._flat_plan
+        assert plan is not None, "flat plan not built yet"
+        out = {}
+        for bi, b in enumerate(plan.buckets):
+            m = opt_state[self._master_key(bi)]
+            for (_i, name, off, size, shape) in b.views:
+                out[name] = m[off:off + size].reshape(shape)
+        return out
+
+    def master_params_placed(self, opt_state):
+        """Masters as full fp32 params at their param shardings — what a
+        demoted (non-flat, non-AMP) run continues from."""
+        import jax
+
+        named = self.master_params_named(opt_state)
+        return {n: jax.device_put(np.asarray(v, np.float32),
+                                  self._sharding_for(n))
+                for n, v in named.items()}
+
+    def amp_state_blob(self, opt_state):
+        """Host snapshot of the scaler scalars for checkpoints."""
+        return {
+            "scale": float(np.asarray(opt_state[self.AMP_SCALE_KEY])),
+            "good": float(np.asarray(opt_state[self.AMP_GOOD_KEY])),
+        }
+
     def _ensure_flat_plan(self, params):
         if self._flat_plan is None:
             shapes = {n: tuple(params[n].shape) for n in self.param_names}
             dtypes = {n: str(params[n].dtype) for n in self.param_names}
+            comm_itemsize = None
+            if self.amp:
+                # the plan describes the fp32 MASTER slabs regardless of
+                # whether it is built from fp32 params (make_state) or
+                # their bf16 working copies (step trace) — same layout
+                # either way; the cap counts bf16 wire bytes
+                dtypes = {n: ("float32" if d == "bfloat16" else d)
+                          for n, d in dtypes.items()}
+                comm_itemsize = 2
             self._flat_plan = _FlatUpdatePlan(
                 self.param_names, shapes, dtypes, self.optimizer,
-                self.mesh.shape["dp"], self.flat_bucket_bytes)
+                self.mesh.shape["dp"], self.flat_bucket_bytes,
+                comm_itemsize=comm_itemsize)
         return self._flat_plan
 
     def _flat_state_sharding(self):
@@ -397,6 +530,10 @@ class ShardedTrainStep:
 
         placed = {n: _place(n, s) for n, s in named.items()}
         self.flat_mode = None
+        # AMP cannot outlive the flat path (the masters ARE the slabs);
+        # callers reconstitute fp32 params via master_params_placed()
+        # BEFORE this conversion drops the master/scale keys
+        self.amp = False
         self._step = None
         self._step_multi = {}
         return placed
@@ -461,6 +598,11 @@ class ShardedTrainStep:
                 placed = _place_flat(st)
                 if placed is not None:
                     state[self._flat_key(bi)] = placed
+            if self.amp:
+                # params here must be fp32 truth (callers pass the placed
+                # fp32 params BEFORE amp_cast_params) — they become the
+                # master slabs
+                state.update(self.build_amp_master_state(params))
             return state
         state = {}
         for i, name in enumerate(self.param_names):
@@ -513,6 +655,8 @@ class ShardedTrainStep:
             )
         params, aux = self.place_params(host_params, host_aux)
         opt_state = self.make_state(params)
+        if self.amp:
+            params = self.amp_cast_params(params)
         return params, aux, opt_state
 
     # ------------------------------------------------------------------
@@ -595,6 +739,198 @@ class ShardedTrainStep:
         st = _wrap_state(st_c, NDArray)
         opt.update(bucket.rep_index, w, g, st)
         return w._data, _unwrap_state(st) if st is not None else None
+
+    def _flat_body_amp(self, bucket, m_c, g_c, st_c, lr, t, inv_scale,
+                       finite):
+        """One AMP optimizer step on a width-S chunk: bf16 grad in, fp32
+        master + state updated, bf16 weight copy out; non-finite steps
+        pass old values through bitwise (branchless select).
+
+        Optimizers that declare a `fused_slab_kernel` run the Pallas
+        kernel (ops/pallas_kernels.fused_slab_update) when
+        MXTPU_FUSED_UPDATE_KERNEL allows — one VMEM pass for the whole
+        unscale/update/cast chain — or its shared-math jnp reference
+        otherwise (same `_slab_update_math`, so toggling the kernel
+        changes codegen, not formulas). Other elementwise optimizers
+        trace through their own Optimizer.update on the unscaled fp32
+        gradient exactly like `_flat_body`."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+        from ..ops import pallas_kernels as pk
+
+        opt = self.optimizer
+        opt.lr = lr
+        opt._index_update_count = _EveryKeyCount(t)
+        kind = getattr(opt, "fused_slab_kernel", None)
+        if kind == "sgd" and getattr(opt, "momentum", 0.0):
+            kind = "sgd_mom"
+        if kind is not None:
+            kwargs = opt._fused_kwargs(bucket.rep_index)
+            lr_eff = kwargs["lr"]
+            if kind == "adam":
+                tt = opt._index_update_count[bucket.rep_index]
+                lr_eff = lr_eff * (
+                    (1.0 - opt.beta2 ** tt) ** 0.5
+                    / (1.0 - opt.beta1 ** tt))
+            states = ()
+            if st_c is not None:
+                states = st_c if isinstance(st_c, tuple) else (st_c,)
+            fn = (pk.fused_slab_update if pk.fused_update_enabled()
+                  else pk.slab_update_reference)
+            nm, nst, w16 = fn(
+                kind, m_c, g_c, states, lr_eff, inv_scale, finite,
+                wd=kwargs["wd"], rescale_grad=kwargs["rescale_grad"],
+                clip_gradient=kwargs["clip_gradient"],
+                momentum=getattr(opt, "momentum", 0.0),
+                beta1=getattr(opt, "beta1", 0.9),
+                beta2=getattr(opt, "beta2", 0.999),
+                epsilon=getattr(opt, "epsilon", 1e-8))
+            if st_c is None:
+                new_st = None
+            elif isinstance(st_c, tuple):
+                new_st = tuple(nst)
+            else:
+                new_st = nst[0]
+            return nm, new_st, w16
+        # generic elementwise optimizer: unscale to fp32, trace through
+        # its own update, select, cast
+        g32 = g_c.astype(jnp.float32) * inv_scale
+        w = NDArray(m_c)
+        g = NDArray(g32)
+        st = _wrap_state(st_c, NDArray)
+        opt.update(bucket.rep_index, w, g, st)
+        keep = finite > jnp.float32(0.5)
+        nm = jnp.where(keep, w._data, m_c)
+        nst_raw = _unwrap_state(st) if st is not None else None
+        new_st = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(keep, new, old), nst_raw, st_c)
+        return nm, new_st, nm.astype(jnp.bfloat16)
+
+    def _apply_optimizer_flat_amp(self, params, grads, opt_state, lr, t):
+        """The AMP twin of _apply_optimizer_flat. Differences:
+
+        - no weight concat: the fp32 masters already live as flat slabs
+          in opt_state, so only gradients get flattened per bucket
+        - one global finite flag over every flat grad slab gates ALL
+          buckets identically (a half-applied step could never be
+          resumed consistently)
+        - in "shard" mode the all-gather moves the bf16 weight copy —
+          half the weight-collective bytes of the fp32 path
+        - the loss scaler (scale, good-step count) updates in-graph:
+          ×2 after `amp_scale_window` consecutive finite steps, ×0.5
+          (floor 1.0) on any non-finite step, which also skips the
+          update bitwise-cleanly via the finite select."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        plan = self._ensure_flat_plan(params)
+        dp = self.mesh.shape["dp"]
+        scale = opt_state[self.AMP_SCALE_KEY]
+        good = opt_state[self.AMP_GOOD_KEY]
+        new_params, new_state = {}, {}
+        # pass 1: flatten grads (bf16) per bucket + global finite flag
+        flat_gs = []
+        finite = jnp.asarray(True)
+        for b in plan.buckets:
+            pad = b.padded - b.size
+            g_parts = [grads[name].reshape(-1)
+                       for (_i, name, _o, _s, _sh) in b.views]
+            if pad:
+                g_parts.append(jnp.zeros((pad,), g_parts[0].dtype))
+            flat_g = jnp.concatenate(g_parts)
+            # same hard fusion boundary as the fp32 path: the update
+            # consumes materialized slabs, not fused gradient chains
+            flat_g = jax.lax.optimization_barrier(flat_g)
+            # pin the grad slab replicated: without this the partitioner
+            # rebuilds each bucket's concat from partial per-tensor sums
+            # with a SECOND full-slab all-reduce (observed on the CPU
+            # partitioner at multi-bucket sizes). The fp32 path cannot
+            # pin its grad concat (bitwise shard<->replicated parity
+            # constraints, see _apply_optimizer_flat); the AMP path has
+            # no such cross-mode bitwise contract.
+            flat_g = jax.lax.with_sharding_constraint(
+                flat_g, NamedSharding(self.mesh, P()))
+            finite = jnp.logical_and(
+                finite, jnp.all(jnp.isfinite(flat_g)))
+            flat_gs.append(flat_g)
+        finite_f = finite.astype(jnp.float32)
+        inv_scale = jnp.float32(1.0) / scale
+        with self._patched_optimizer(lr, t):
+            for bi, b in enumerate(plan.buckets):
+                flat_g = flat_gs[bi]
+                master = opt_state[self._master_key(bi)]
+                st = opt_state.get(self._flat_key(bi))
+
+                if self.flat_mode == "shard":
+                    from jax.experimental.shard_map import shard_map
+
+                    def body(m_c, g_c, st_c, lr_c, t_c, inv_c, fin_c,
+                             _b=b):
+                        nm, nst, w16 = self._flat_body_amp(
+                            _b, m_c, g_c, st_c, lr_c, t_c, inv_c, fin_c)
+                        # the bf16 copy rejoins the replicated dispatch
+                        # plan; master + state stay on their shard
+                        w16_full = jax.lax.all_gather(
+                            w16, "dp", tiled=True)
+                        return w16_full, nm, nst
+
+                    w16_full, nmaster, nst = shard_map(
+                        body, mesh=self.mesh,
+                        in_specs=(P("dp"), P("dp"), P("dp"), P(), P(),
+                                  P(), P()),
+                        out_specs=(P(), P("dp"), P("dp")),
+                        check_rep=False,
+                    )(master, flat_g, st, lr, t, inv_scale, finite_f)
+                else:
+                    S = b.padded // dp
+
+                    def scan_body(carry, xs, _b=b):
+                        m_c, g_c, st_c = xs
+                        return carry, self._flat_body_amp(
+                            _b, m_c, g_c, st_c, lr, t, inv_scale,
+                            finite_f)
+
+                    m2 = master.reshape(dp, S)
+                    g2 = flat_g.reshape(dp, S)
+                    st2 = jax.tree_util.tree_map(
+                        lambda a: a.reshape(dp, S), st)
+                    _, (nm2, nst2, w16_2) = jax.lax.scan(
+                        scan_body, 0, (m2, g2, st2))
+                    nmaster = nm2.reshape(b.padded)
+                    nst = jax.tree_util.tree_map(
+                        lambda a: a.reshape(b.padded), nst2)
+                    w16_full = w16_2.reshape(b.padded)
+
+                for (_i, name, off, size, shape) in b.views:
+                    new_params[name] = (
+                        w16_full[off:off + size].reshape(shape))
+                new_state[self._master_key(bi)] = nmaster
+                if nst is not None:
+                    new_state[self._flat_key(bi)] = nst
+        # dynamic loss scaler (grow/backoff), branchless
+        window = jnp.float32(self.amp_scale_window)
+        grown = (good + 1.0) >= window
+        new_state[self.AMP_SCALE_KEY] = jnp.where(
+            finite,
+            jnp.where(grown,
+                      jnp.minimum(scale * 2.0,
+                                  jnp.float32(self.amp_scale_max)),
+                      scale),
+            jnp.maximum(scale * 0.5, jnp.float32(1.0)))
+        new_state[self.AMP_GOOD_KEY] = jnp.where(
+            finite,
+            jnp.where(grown, jnp.float32(0.0), good + 1.0),
+            jnp.float32(0.0))
+        for name in params:
+            if name not in new_params:
+                new_params[name] = params[name]
+        for k in opt_state:
+            if k not in new_state:
+                new_state[k] = opt_state[k]
+        return new_params, new_state
 
     def _apply_optimizer_flat(self, params, grads, opt_state, lr, t):
         """Bucketed flat update: concat params/grads per bucket, run the
@@ -710,15 +1046,31 @@ class ShardedTrainStep:
 
         program = self.program
         do_mirror = _mirror_enabled()
+        amp = self.amp
+        amp_cast = set(self.data_names) if (amp and self.amp_cast_data) \
+            else set()
 
         def step(params, aux, opt_state, batch, rng, lr, t):
+            if amp_cast:
+                # bf16 activations from the first op: cast floating DATA
+                # feeds (never labels — loss heads compare against them
+                # exactly). MXTPU_AMP_CAST_DATA=0 keeps feeds untouched.
+                batch = {
+                    n: (v.astype(jnp.bfloat16)
+                        if (n in amp_cast
+                            and jnp.issubdtype(v.dtype, jnp.floating))
+                        else v)
+                    for n, v in batch.items()}
+
             def loss_fn(ps):
                 args = dict(ps)
                 args.update(batch)
                 outs, new_aux = program(args, aux, rng, True)
                 # *Output heads: drive vjp with ones (Executor.backward
                 # convention — the loss op bakes its own gradient)
-                return sum(jnp.sum(o) for o in outs), (outs, new_aux)
+                loss = sum(jnp.sum(o.astype(jnp.float32) if amp else o)
+                           for o in outs)
+                return loss, (outs, new_aux)
 
             if do_mirror:
                 # MXNET_BACKWARD_DO_MIRROR: rematerialize cheap ops in
@@ -726,6 +1078,20 @@ class ShardedTrainStep:
                 loss_fn = jax.checkpoint(loss_fn, policy=_mirror_policy)
 
             grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
+            if amp:
+                # Loss scaling rides the GRADIENT stream, not the loss
+                # value: every loss head here ignores its incoming
+                # cotangent by design (softmax_output-inl.h Backward —
+                # ops/nn.py), so scaling the summed loss would never
+                # reach the gradients. Multiplying the post-chain grads
+                # by the scale is equivalent (bf16 carries fp32's full
+                # exponent range, so the chain itself cannot overflow at
+                # any representable scale) and exact for the
+                # power-of-two scales the scaler produces.
+                scale = opt_state[self.AMP_SCALE_KEY]
+                grads = {k: g * scale.astype(g.dtype)
+                         for k, g in grads.items()}
+                outs = [o.astype(jnp.float32) for o in outs]
             # gradient allreduce over dp happens implicitly: params are
             # replicated, batch is dp-sharded → GSPMD inserts psum here.
             # (In flat "shard" mode the P("dp") in_specs then slice that
@@ -744,11 +1110,23 @@ class ShardedTrainStep:
                 rep = NamedSharding(self.mesh, P())
                 grads = {k: jax.lax.with_sharding_constraint(g, rep)
                          for k, g in grads.items()}
-            apply = (self._apply_optimizer_flat
-                     if self.flat_mode is not None
-                     else self._apply_optimizer)
+            if amp:
+                apply = self._apply_optimizer_flat_amp
+            elif self.flat_mode is not None:
+                apply = self._apply_optimizer_flat
+            else:
+                apply = self._apply_optimizer
             new_params, new_opt = apply(params, grads, opt_state, lr, t)
             new_aux = {**aux, **new_aux}  # carry shared-owner extras through
+            if amp:
+                # aux state (BN moving stats) keeps its fp32 dtype across
+                # steps even when bf16 activations produced the batch
+                # statistics this step folded in
+                new_aux = {
+                    k: (v.astype(aux[k].dtype)
+                        if (k in aux and hasattr(v, "dtype")
+                            and v.dtype != aux[k].dtype) else v)
+                    for k, v in new_aux.items()}
             return new_params, new_aux, new_opt, outs
 
         return step
@@ -857,7 +1235,8 @@ class ShardedTrainStep:
             _tm.anatomy.capture_cost(
                 self.program._program_uid, ("multi", k) + sig,
                 lambda: fn.lower(params, aux, opt_state, batches, rngs,
-                                 lrs_arr, ts_arr).compile())
+                                 lrs_arr, ts_arr).compile(),
+                dtype="bf16" if self.amp else "f32")
         _M_STEPS.inc(k, path="multi")
         with _tm.span("train_step.dispatch", k=k):
             return fn(params, aux, opt_state, batches, rngs,
@@ -908,7 +1287,8 @@ class ShardedTrainStep:
             _tm.anatomy.capture_cost(
                 self.program._program_uid, ("single",) + sig,
                 lambda: self._step.lower(params, aux, opt_state, batch,
-                                         rng, lr_arr, t_arr).compile())
+                                         rng, lr_arr, t_arr).compile(),
+                dtype="bf16" if self.amp else "f32")
         _M_STEPS.inc(path="single")
         with _tm.span("train_step.dispatch", t=t):
             return self._step(params, aux, opt_state, batch, rng,

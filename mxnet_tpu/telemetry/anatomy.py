@@ -99,14 +99,16 @@ _cost_cache = {}  # (program_uid, key) -> {"flops", "bytes_accessed"} | None
 _current_cost = None  # the cost dict of the most recently dispatched program
 
 
-def capture_cost(program_uid, key, compile_thunk, steps=1):
+def capture_cost(program_uid, key, compile_thunk, steps=1, dtype=None):
     """Resolve the per-step device cost of one compiled program.
 
     ``compile_thunk`` must return a jax AOT ``Compiled`` (built from the
     SAME abstract args the dispatch will use); it runs at most once per
     (program, signature). ``steps`` divides multi-step (scan-K) program
-    totals back to per-step. Failures cache as None — never retried,
-    never raised.
+    totals back to per-step. ``dtype`` tags the program's compute dtype
+    ("bf16"/"f32") so MFU is computed against the right roofline — fp32
+    compute can never reach the bf16 peak the tables quote. Failures
+    cache as None — never retried, never raised.
     """
     global _current_cost
     ck = (program_uid, key)
@@ -127,6 +129,8 @@ def capture_cost(program_uid, key, compile_thunk, steps=1):
                 "bytes_accessed":
                     (raw["bytes_accessed"] or 0.0) / max(steps, 1),
             }
+            if dtype:
+                cost["compute_dtype"] = str(dtype)
     except Exception as exc:
         _LOG.debug("cost capture failed (program=%s): %s", program_uid, exc)
     with _lock:
@@ -305,7 +309,13 @@ def emit_interval(force=False):
         record["flops_per_step"] = cost["flops"]
         record["bytes_per_step"] = cost["bytes_accessed"]
         kind = _device_kind()
-        pf = costmodel.peak_flops_for_kind(kind)
+        dtype = cost.get("compute_dtype")
+        if dtype:
+            record["compute_dtype"] = dtype
+        record["device_kind"] = kind
+        # dtype-aware roofline: fp32 programs are measured against the
+        # derated fp32 peak, not the bf16 number the chip is sold on
+        pf = costmodel.peak_flops_for_kind(kind, dtype)
         pb = costmodel.peak_bytes_for_kind(kind)
         if cost["flops"] and pf and wall > 0:
             mfu = cost["flops"] * steps / wall / pf

@@ -57,9 +57,19 @@ def _lookup(kind, table):
     return None
 
 
-def peak_flops_for_kind(kind):
+def peak_flops_for_kind(kind, dtype=None):
     """Peak FLOP/s for a device kind, or None if unknown.
-    ``MXTPU_ANATOMY_PEAK_TFLOPS`` (in TFLOP/s) overrides the table."""
+
+    The table quotes each chip's native dense bf16 peak (the number the
+    spec sheets and MFU targets are stated in). fp32 compute drives the
+    MXU in multi-pass mode at roughly a third of that rate, so
+    ``dtype`` "f32"/"float32" derates the table value by
+    ``MXTPU_ANATOMY_F32_DERATE`` (default 3). "bf16"/None return the
+    table peak unchanged.
+
+    ``MXTPU_ANATOMY_PEAK_TFLOPS`` (in TFLOP/s) overrides the table and
+    returns WITHOUT any dtype derate — deterministic tests pin exact
+    peaks through it."""
     env = os.environ.get("MXTPU_ANATOMY_PEAK_TFLOPS")
     if env:
         try:
@@ -67,7 +77,17 @@ def peak_flops_for_kind(kind):
         except ValueError:
             pass
     tf = _lookup(kind, _KIND_PEAK_TFLOPS)
-    return tf * 1e12 if tf is not None else None
+    if tf is None:
+        return None
+    peak = tf * 1e12
+    if dtype and str(dtype).lower() in ("f32", "fp32", "float32"):
+        try:
+            derate = float(os.environ.get("MXTPU_ANATOMY_F32_DERATE", "3"))
+        except ValueError:
+            derate = 3.0
+        if derate > 0:
+            peak /= derate
+    return peak
 
 
 def peak_bytes_for_kind(kind):

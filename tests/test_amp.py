@@ -1,0 +1,639 @@
+"""Mixed-precision training (ISSUE 8 tentpole acceptance).
+
+MXTPU_AMP=bf16 on the flat fused-update path: bf16 forward/backward and
+collectives, fp32 master-weight slabs, dynamic loss scaling, and the
+fused Pallas optimizer-slab kernel. These tests pin the contracts:
+
+- the working params are exactly bf16(masters) at every step boundary;
+- a non-finite gradient skips the step bitwise-cleanly (params, masters,
+  optimizer state, step count all unchanged), halves the scale, and
+  training continues;
+- the scale doubles after MXTPU_LOSS_SCALE_WINDOW consecutive finite
+  steps;
+- the Pallas slab kernel (interpret mode off-TPU) matches the jnp
+  reference chain across device counts and optimizers;
+- kvstore gradient buckets group by dtype, the byte cap counts actual
+  itemsize, and MXTPU_BUCKET_REDUCE_DTYPE upcasts only the sum;
+- checkpoints are dtype-portable (AMP <-> fp32 both directions,
+  including SIGKILL crash-resume through resilience checkpoints), and
+  an AMP->AMP resume is bitwise-identical to an uninterrupted run.
+"""
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import checkpoint as ck
+from mxnet_tpu.resilience import fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp_net(num_hidden=16, num_classes=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _lenet_net():
+    """lenet-shaped convnet scaled for an 8x8 synthetic task."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit_mlp(ndev, optname="sgd", num_epoch=2):
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(42)
+    X = rng.randn(128, 8).astype(np.float32)
+    y = rng.randint(0, 4, 128).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_net(),
+                        context=[mx.cpu(i) for i in range(ndev)])
+    metric = mx.metric.create("acc")
+    opt_params = {"learning_rate": 0.1, "rescale_grad": 1.0 / 16}
+    if optname == "sgd":
+        opt_params["momentum"] = 0.9
+    mod.fit(it, eval_metric=metric, kvstore="device", optimizer=optname,
+            optimizer_params=opt_params,
+            initializer=mx.init.Uniform(0.1), num_epoch=num_epoch)
+    assert mod._fused_trainer is not None, "fused path did not engage"
+    return mod, metric
+
+
+def _fit_lenet(ndev, num_epoch=4):
+    """Separable conv task: class = (left-half mean > right-half mean)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(7)
+    X = rng.randn(128, 1, 8, 8).astype(np.float32)
+    y = (X[:, 0, :, :4].mean(axis=(1, 2))
+         > X[:, 0, :, 4:].mean(axis=(1, 2))).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_lenet_net(),
+                        context=[mx.cpu(i) for i in range(ndev)])
+    metric = mx.metric.create("acc")
+    mod.fit(it, eval_metric=metric, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9,
+                              "rescale_grad": 1.0 / 32},
+            initializer=mx.init.Uniform(0.1), num_epoch=num_epoch)
+    assert mod._fused_trainer is not None
+    return mod, metric
+
+
+def _masters(mod):
+    owner = mod._fused_owner
+    return owner._fused_trainer.master_params_named(owner._fused_opt)
+
+
+# ---------------------------------------------------------------------------
+# AMP lifecycle invariants through Module.fit
+# ---------------------------------------------------------------------------
+
+def test_amp_engages_and_master_invariant(monkeypatch):
+    """MXTPU_AMP=bf16: bf16 working params, fp32 masters, and
+    params == bf16(masters) exactly at the post-fit boundary; the host
+    view (get_params) is the fp32 truth."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    mod, metric = _fit_mlp(4, "sgd")
+    tr = mod._fused_owner._fused_trainer
+    assert tr.amp and tr.flat_mode is not None
+    assert np.isfinite(metric.get()[1])
+    masters = _masters(mod)
+    for name, p in mod._fused_owner._fused_params.items():
+        assert p.dtype == jnp.bfloat16, (name, p.dtype)
+        m = masters[name]
+        assert np.asarray(m).dtype == np.float32, name
+        np.testing.assert_array_equal(
+            np.asarray(p), np.asarray(jnp.asarray(m, jnp.bfloat16)),
+            err_msg="%s != bf16(master)" % name)
+    arg, _ = mod.get_params()
+    for name, v in arg.items():
+        assert v.asnumpy().dtype == np.float32, name
+        np.testing.assert_array_equal(v.asnumpy(),
+                                      np.asarray(masters[name]))
+    # scaler state lives in opt_state as replicated scalars
+    scale = float(np.asarray(
+        mod._fused_owner._fused_opt[tr.AMP_SCALE_KEY]))
+    assert scale >= 1.0
+
+
+def test_amp_requires_flat_path(monkeypatch):
+    """dp=1 has no flat path: AMP must decline (warning) and run fp32."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(42)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_net(), context=[mx.cpu(0)])
+    metric = mx.metric.create("acc")
+    mod.fit(it, eval_metric=metric, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1,
+                              "rescale_grad": 1.0 / 16},
+            initializer=mx.init.Uniform(0.1), num_epoch=1)
+    if mod._fused_trainer is not None:
+        assert not mod._fused_owner._fused_trainer.amp
+    assert np.isfinite(metric.get()[1])
+
+
+def test_amp_lenet_convergence_gate(monkeypatch):
+    """The acceptance convergence gate: bf16-AMP lenet must land within
+    tolerance of the fp32 run on the same separable task."""
+    monkeypatch.delenv("MXTPU_AMP", raising=False)
+    _, met_f32 = _fit_lenet(4)
+    acc_f32 = met_f32.get()[1]
+
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    mod, met_amp = _fit_lenet(4)
+    assert mod._fused_owner._fused_trainer.amp
+    acc_amp = met_amp.get()[1]
+    assert acc_f32 > 0.7, acc_f32  # the task is learnable at all
+    assert acc_amp >= acc_f32 - 0.05, (acc_amp, acc_f32)
+
+
+# ---------------------------------------------------------------------------
+# loss scaler: overflow skip + growth (direct trainer stepping)
+# ---------------------------------------------------------------------------
+
+def _direct_trainer(ndev, batch=16, in_dim=8):
+    import jax
+
+    from jax.sharding import Mesh
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.parallel import ShardedTrainStep
+
+    net = _mlp_net()
+    mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   rescale_grad=1.0 / batch)
+    trainer = ShardedTrainStep(net, mesh, optimizer=o).compile()
+    shapes = {"data": (batch, in_dim), "softmax_label": (batch,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    shapes_by_name = dict(zip(net.list_arguments(), arg_shapes))
+    params, aux, state = trainer.init(shapes_by_name,
+                                      mx.initializer.Uniform(0.1))
+    return trainer, params, aux, state
+
+
+def _place_batch(trainer, X, y):
+    import jax
+
+    return {"data": jax.device_put(X, trainer.batch_sharding()),
+            "softmax_label": jax.device_put(y, trainer.batch_sharding())}
+
+
+def _host_tree(d):
+    return {k: np.asarray(v) for k, v in d.items()}
+
+
+def test_amp_overflow_skips_bitwise_and_recovers(monkeypatch):
+    """A batch that produces non-finite gradients must leave params,
+    masters, and optimizer state bitwise untouched, halve the scale,
+    reset the good-step count — and the next finite batch must train
+    normally at the reduced scale."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_SHARD_UPDATE", "1")
+    trainer, params, aux, state = _direct_trainer(2)
+    assert trainer.amp
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+
+    params, aux, state, _ = trainer(
+        params, aux, state, _place_batch(trainer, X, y), t=1)
+    snap_p = _host_tree(params)
+    snap_s = _host_tree({k: v for k, v in state.items()})
+    scale0 = float(np.asarray(state[trainer.AMP_SCALE_KEY]))
+    good0 = float(np.asarray(state[trainer.AMP_GOOD_KEY]))
+    assert good0 == 1.0
+
+    # bf16 shares fp32's exponent range, so ordinary activations cannot
+    # overflow it — poison the data to force non-finite grads
+    X_bad = X.copy()
+    X_bad[0, 0] = np.inf
+    params, aux, state, _ = trainer(
+        params, aux, state, _place_batch(trainer, X_bad, y), t=2)
+    for k, v in _host_tree(params).items():
+        np.testing.assert_array_equal(v, snap_p[k],
+                                      err_msg="param %s changed" % k)
+    for k, v in _host_tree(state).items():
+        if k in (trainer.AMP_SCALE_KEY, trainer.AMP_GOOD_KEY):
+            continue
+        np.testing.assert_array_equal(v, snap_s[k],
+                                      err_msg="state %s changed" % k)
+    assert float(np.asarray(state[trainer.AMP_SCALE_KEY])) == scale0 / 2
+    assert float(np.asarray(state[trainer.AMP_GOOD_KEY])) == 0.0
+
+    # clean continuation: finite step applies an update again
+    params, aux, state, _ = trainer(
+        params, aux, state, _place_batch(trainer, X, y), t=3)
+    changed = any(
+        not np.array_equal(np.asarray(v), snap_p[k])
+        for k, v in params.items())
+    assert changed, "finite step after overflow did not update"
+    assert float(np.asarray(state[trainer.AMP_GOOD_KEY])) == 1.0
+    assert float(np.asarray(state[trainer.AMP_SCALE_KEY])) == scale0 / 2
+    for v in _host_tree(params).values():
+        assert np.isfinite(v.astype(np.float32)).all()
+
+
+def test_amp_scale_growth(monkeypatch):
+    """MXTPU_LOSS_SCALE_WINDOW consecutive finite steps double the
+    scale and reset the counter."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE", "8")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE_WINDOW", "3")
+    trainer, params, aux, state = _direct_trainer(2)
+    assert trainer.amp
+    assert float(np.asarray(state[trainer.AMP_SCALE_KEY])) == 8.0
+    rng = np.random.RandomState(5)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+    batch = _place_batch(trainer, X, y)
+    for t in (1, 2):
+        params, aux, state, _ = trainer(params, aux, state, batch, t=t)
+        assert float(np.asarray(state[trainer.AMP_SCALE_KEY])) == 8.0
+        assert float(np.asarray(state[trainer.AMP_GOOD_KEY])) == t
+    params, aux, state, _ = trainer(params, aux, state, batch, t=3)
+    assert float(np.asarray(state[trainer.AMP_SCALE_KEY])) == 16.0
+    assert float(np.asarray(state[trainer.AMP_GOOD_KEY])) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas slab kernel vs jnp reference
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["sgd", "sgd_mom", "adam"])
+@pytest.mark.parametrize("size", [131, 1024, 5000])
+def test_slab_kernel_matches_reference(kind, size):
+    """fused_slab_update (interpret mode) vs slab_update_reference on
+    odd/padded sizes; finite=0 must return the inputs bitwise."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import (
+        _SLAB_STATE_SLOTS, fused_slab_update, slab_update_reference)
+
+    rng = np.random.RandomState(size + len(kind))
+    w = jnp.asarray(rng.randn(size).astype(np.float32))
+    g = jnp.asarray((rng.randn(size) * 4).astype(np.float32),
+                    jnp.bfloat16)
+    states = tuple(
+        jnp.asarray(rng.randn(size).astype(np.float32) * 0.1)
+        for _ in range(_SLAB_STATE_SLOTS[kind]))
+    kw = dict(wd=0.0001, rescale_grad=1.0 / 32, clip_gradient=None,
+              momentum=0.9, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    for finite in (1.0, 0.0):
+        ref_w, ref_st, ref_w16 = slab_update_reference(
+            kind, w, g, states, 0.05, 1.0 / 128, finite, **kw)
+        got_w, got_st, got_w16 = fused_slab_update(
+            kind, w, g, states, 0.05, 1.0 / 128, finite,
+            interpret=True, **kw)
+        np.testing.assert_allclose(np.asarray(got_w), np.asarray(ref_w),
+                                   rtol=1e-6, atol=1e-7)
+        for a, b in zip(got_st, ref_st):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(
+            np.asarray(got_w16.astype(jnp.float32)),
+            np.asarray(ref_w16.astype(jnp.float32)))
+        if finite == 0.0:
+            np.testing.assert_array_equal(np.asarray(got_w),
+                                          np.asarray(w))
+
+
+@pytest.mark.parametrize("ndev,optname", [(2, "sgd"), (4, "adam"),
+                                          (8, "sgd")])
+def test_amp_kernel_vs_reference_fit(monkeypatch, ndev, optname):
+    """End-to-end: MXTPU_FUSED_UPDATE_KERNEL=1 (interpret Pallas) vs =0
+    (jnp chain) across simulated device counts — same masters and
+    working params to float tolerance after a full fit."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_SHARD_UPDATE", "1")
+
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE_KERNEL", "0")
+    mod_r, met_r = _fit_mlp(ndev, optname, num_epoch=1)
+    ref = {k: np.asarray(v) for k, v in _masters(mod_r).items()}
+
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE_KERNEL", "1")
+    mod_k, met_k = _fit_mlp(ndev, optname, num_epoch=1)
+    got = {k: np.asarray(v) for k, v in _masters(mod_k).items()}
+
+    assert sorted(got) == sorted(ref)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5, atol=1e-7,
+                                   err_msg="%s drifted" % k)
+    assert abs(met_k.get()[1] - met_r.get()[1]) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# kvstore gradient buckets: dtype grouping + reduce-dtype upcast
+# ---------------------------------------------------------------------------
+
+def test_bucketer_groups_by_dtype_and_counts_itemsize():
+    """Same-dtype buckets; the byte cap counts actual dtype bytes, so a
+    half-precision model packs 2x the elements per bucket."""
+    from mxnet_tpu.kvstore import GradBucketer
+
+    def entry(b, prio, key, arr):
+        b.add(prio, key, key, {}, arr, lambda *a: None)
+
+    # cap 64 bytes: 16 f32 fill a bucket; 16 f16 leave room for 16 more
+    b = GradBucketer(64)
+    entry(b, 0, 0, np.zeros(16, np.float32))
+    entry(b, 0, 1, np.zeros(16, np.float16))
+    entry(b, 0, 2, np.zeros(16, np.float16))
+    buckets = b.drain()
+    assert len(buckets) == 2
+    by_dtype = {bk[0].dtype: bk for bk in buckets}
+    assert len(by_dtype[np.dtype(np.float32)]) == 1
+    assert len(by_dtype[np.dtype(np.float16)]) == 2  # 2x16x2B == 64B cap
+    for bk in buckets:
+        assert len({e.dtype for e in bk}) == 1
+    # nbytes reflects the real itemsize
+    assert by_dtype[np.dtype(np.float16)][0].nbytes == 32
+    assert by_dtype[np.dtype(np.float32)][0].nbytes == 64
+
+
+def test_bucket_reduce_dtype_round_trip(monkeypatch):
+    """MXTPU_BUCKET_REDUCE_DTYPE=float32 upcasts the bucket sum only;
+    the carve-back recasts, so pulled values keep the push dtype and
+    round-trip exactly at P=1."""
+    monkeypatch.setenv("MXTPU_BUCKET_REDUCE_DTYPE", "float32")
+    monkeypatch.setenv("MXNET_KVSTORE_ASYNC", "0")
+    kv = mx.kv.create("local")
+    kv.type = "dist_sync"  # fake dist: collectives pass through at P=1
+    kv._size = 2
+    vals = np.arange(5, dtype=np.float16)
+    kv.init(0, mx.nd.zeros((5,), dtype=np.float16))
+    kv.push(0, mx.nd.array(vals, dtype=np.float16))
+    kv._flush_buckets()
+    out = mx.nd.zeros((5,), dtype=np.float16)
+    kv.pull(0, out=out)
+    assert out.asnumpy().dtype == np.float16
+    np.testing.assert_array_equal(out.asnumpy(), vals)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint dtype portability (in-process capture/restore)
+# ---------------------------------------------------------------------------
+
+def test_amp_checkpoint_cross_dtype_both_directions(monkeypatch):
+    """An AMP snapshot's "arg" is the fp32 masters, so it restores into
+    an fp32 run unchanged; an fp32 snapshot restores into an AMP run
+    (masters = snapshot params, working = their bf16 cast, fresh
+    scaler)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    mod_amp, _ = _fit_mlp(2, "sgd", num_epoch=1)
+    blob_amp = mod_amp._capture_train_state()
+    amp_arg = {k: np.asarray(v) for k, v in blob_amp["arg"].items()}
+    assert all(v.dtype == np.float32 for v in amp_arg.values())
+    assert "amp" in blob_amp["opt"]
+
+    # AMP checkpoint -> fp32 run
+    monkeypatch.delenv("MXTPU_AMP", raising=False)
+    mod_f32, _ = _fit_mlp(2, "sgd", num_epoch=1)
+    assert not mod_f32._fused_owner._fused_trainer.amp
+    mod_f32._restore_train_state(
+        {k: ({kk: np.asarray(vv) for kk, vv in v.items()}
+             if k in ("arg", "aux") else v)
+         for k, v in blob_amp.items()})
+    for name, p in mod_f32._fused_owner._fused_params.items():
+        assert p.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(p), amp_arg[name])
+
+    blob_f32 = mod_f32._capture_train_state()
+    f32_arg = {k: np.asarray(v) for k, v in blob_f32["arg"].items()}
+
+    # fp32 checkpoint -> AMP run
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    mod_amp2, _ = _fit_mlp(2, "sgd", num_epoch=1)
+    tr2 = mod_amp2._fused_owner._fused_trainer
+    assert tr2.amp
+    mod_amp2._restore_train_state(
+        {k: ({kk: np.asarray(vv) for kk, vv in v.items()}
+             if k in ("arg", "aux") else v)
+         for k, v in blob_f32.items()})
+    masters = _masters(mod_amp2)
+    for name, m in masters.items():
+        np.testing.assert_array_equal(np.asarray(m), f32_arg[name])
+        np.testing.assert_array_equal(
+            np.asarray(mod_amp2._fused_owner._fused_params[name]),
+            np.asarray(jnp.asarray(m, jnp.bfloat16)))
+    # fp32 snapshots carry no scaler: AMP restore starts a fresh one
+    scale = float(np.asarray(
+        mod_amp2._fused_owner._fused_opt[tr2.AMP_SCALE_KEY]))
+    assert scale == tr2.amp_scale_init
+    # and the restored module keeps training
+    rng = np.random.RandomState(9)
+    X = rng.randn(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    metric = mx.metric.create("acc")
+    mod_amp2.fit(it, eval_metric=metric, num_epoch=1,
+                 arg_params=mod_amp2._arg_params,
+                 aux_params=mod_amp2._aux_params, force_init=False,
+                 kvstore="device", optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1,
+                                   "momentum": 0.9,
+                                   "rescale_grad": 1.0 / 16})
+    assert np.isfinite(metric.get()[1])
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL crash-resume (subprocess, as in the sharded-update tests)
+# ---------------------------------------------------------------------------
+
+TRAIN_SCRIPT = textwrap.dedent("""\
+    import os, sys
+    sys.path.insert(0, %(repo)r)
+    ndev = int(os.environ.get("T_NDEV", "4"))
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=" + str(ndev))
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+
+    ckpt_dir, out = sys.argv[1], sys.argv[2]
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(42)
+    X = rng.randn(128, 8).astype(np.float32)
+    y = rng.randint(0, 4, 128).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)  # 8 batches/epoch
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(ndev)])
+    metric = mx.metric.create("acc")
+    kw = {}
+    if ckpt_dir != "-":
+        kw = dict(checkpoint_dir=ckpt_dir, resume="auto")
+    mod.fit(it, eval_metric=metric, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / 16},
+            initializer=mx.init.Uniform(0.1), num_epoch=2, **kw)
+    assert mod._fused_trainer is not None
+    tr = mod._fused_owner._fused_trainer
+    want_amp = os.environ.get("T_WANT_AMP")
+    if want_amp is not None:
+        assert tr.amp == (want_amp == "1"), (tr.amp, want_amp)
+
+    arg, aux = mod.get_params()
+    blob = {"arg:" + k: v.asnumpy() for k, v in arg.items()}
+    blob.update({"aux:" + k: v.asnumpy() for k, v in aux.items()})
+    blob["__metric__"] = np.asarray([metric.get()[1]])
+    host = mod._fused_opt_host_state()
+    blob["__t__"] = np.asarray([host["t"]])
+    if host.get("amp"):
+        blob["__amp_scale__"] = np.asarray([host["amp"]["scale"]])
+        blob["__amp_good__"] = np.asarray([host["amp"]["good"]])
+    def _flatten(prefix, s):
+        if s is None:
+            return
+        if isinstance(s, tuple):
+            for j, x in enumerate(s):
+                _flatten(prefix + "." + str(j), x)
+        else:
+            blob["opt:" + prefix] = np.asarray(s)
+    for name, s in host["state"].items():
+        _flatten(name, s)
+    np.savez(out, **blob)
+    print("TRAIN-DONE", flush=True)
+""") % {"repo": REPO}
+
+
+def _run_train(script_dir, ckpt_dir, out, extra_env, timeout=300):
+    script = os.path.join(script_dir, "train_amp.py")
+    if not os.path.exists(script):
+        with open(script, "w") as f:
+            f.write(TRAIN_SCRIPT)
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop(fault.ENV, None)
+    for k in ("MXTPU_AMP", "MXTPU_SHARD_UPDATE", "MXTPU_BUCKET_BYTES",
+              "MXNET_FIT_MULTISTEP", "MXTPU_DEVICE_FEED",
+              "MXTPU_FUSED_UPDATE_KERNEL", "MXTPU_LOSS_SCALE",
+              "MXTPU_LOSS_SCALE_WINDOW"):
+        env.pop(k, None)
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, script, ckpt_dir, out],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def _load_blob(path):
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _assert_bitwise(got, want):
+    assert sorted(got) == sorted(want), (sorted(got), sorted(want))
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k],
+                                      err_msg="%s differs" % k)
+
+
+def test_amp_kill_resume_and_cross_dtype(tmp_path):
+    """SIGKILL mid-epoch under AMP, auto-resume under AMP: bitwise
+    parity with the uninterrupted AMP run (masters, optimizer state,
+    scaler, metric). Then resume the SAME crash checkpoints WITHOUT
+    AMP — the snapshot params are the fp32 masters, so the fp32 run
+    restores and finishes cleanly (cross-dtype portability under
+    crash-resume, not just clean save/load)."""
+    base_env = {"T_NDEV": "4", "MXTPU_AMP": "bf16", "T_WANT_AMP": "1",
+                ck.ENV_INTERVAL: "3"}
+    ref_out = str(tmp_path / "ref.npz")
+    proc = _run_train(str(tmp_path), str(tmp_path / "ref_ck"), ref_out,
+                      base_env)
+    assert proc.returncode == 0, proc.stderr
+    assert "TRAIN-DONE" in proc.stdout
+
+    crash_dir = str(tmp_path / "crash_ck")
+    crash_env = dict(base_env, **{fault.ENV: "kill_at_step=13"})
+    proc = _run_train(str(tmp_path), crash_dir,
+                      str(tmp_path / "unused.npz"), crash_env)
+    assert proc.returncode == -signal.SIGKILL
+    assert ck.list_checkpoints(crash_dir), "no checkpoint survived"
+    crash_copy = str(tmp_path / "crash_ck_copy")
+    shutil.copytree(crash_dir, crash_copy)
+
+    res_out = str(tmp_path / "res.npz")
+    proc = _run_train(str(tmp_path), crash_dir, res_out, base_env)
+    assert proc.returncode == 0, proc.stderr
+    assert "resume: restored step" in proc.stderr
+    ref_blob = _load_blob(ref_out)
+    assert "__amp_scale__" in ref_blob
+    _assert_bitwise(_load_blob(res_out), ref_blob)
+
+    # cross-dtype: the same AMP crash checkpoints, fp32 resume
+    swap_out = str(tmp_path / "swap.npz")
+    proc = _run_train(str(tmp_path), crash_copy, swap_out,
+                      {"T_NDEV": "4", "T_WANT_AMP": "0",
+                       ck.ENV_INTERVAL: "3"})
+    assert proc.returncode == 0, proc.stderr
+    assert "resume: restored step" in proc.stderr
+    swap = _load_blob(swap_out)
+    assert "__amp_scale__" not in swap  # genuinely ran fp32
+    assert np.isfinite(swap["__metric__"][0])
+    # both runs saw identical steps 0..12 (masters are the truth), so
+    # the fc weights must be close even though post-crash arithmetic
+    # ran in different precisions
+    for k in swap:
+        if k.startswith("arg:"):
+            np.testing.assert_allclose(swap[k], ref_blob[k], atol=0.05,
+                                       err_msg=k)
+
+
+def test_fp32_crash_resumes_under_amp(tmp_path):
+    """The reverse direction: crash an fp32 run, resume with
+    MXTPU_AMP=bf16 — params seed the masters, training completes."""
+    crash_dir = str(tmp_path / "crash_ck")
+    proc = _run_train(str(tmp_path), crash_dir,
+                      str(tmp_path / "unused.npz"),
+                      {"T_NDEV": "4", "T_WANT_AMP": "0",
+                       ck.ENV_INTERVAL: "3",
+                       fault.ENV: "kill_at_step=13"})
+    assert proc.returncode == -signal.SIGKILL
+    assert ck.list_checkpoints(crash_dir)
+
+    res_out = str(tmp_path / "res.npz")
+    proc = _run_train(str(tmp_path), crash_dir, res_out,
+                      {"T_NDEV": "4", "MXTPU_AMP": "bf16",
+                       "T_WANT_AMP": "1", ck.ENV_INTERVAL: "3"})
+    assert proc.returncode == 0, proc.stderr
+    assert "resume: restored step" in proc.stderr
+    blob = _load_blob(res_out)
+    assert "__amp_scale__" in blob
+    assert np.isfinite(blob["__metric__"][0])

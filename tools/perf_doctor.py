@@ -158,6 +158,30 @@ def recompile_causes(recompiles):
                   reverse=True)
 
 
+def amp_advice(records):
+    """fp32 compute on a TPU is the one misconfiguration the anatomy
+    stream can see directly: interval records carry the compiled
+    program's ``compute_dtype`` and the ``device_kind``. The MXU's bf16
+    rate is ~2-8x its fp32 rate (the costmodel's F32_DERATE), so an f32
+    step program leaves most of the device idle. Returns an advice
+    string, or None when the run is already bf16 / not on a TPU /
+    untagged."""
+    for r in reversed(records):
+        dtype = r.get("compute_dtype")
+        kind = str(r.get("device_kind", ""))
+        if not dtype:
+            continue
+        on_tpu = "tpu" in kind.lower() or kind.lower().startswith("v")
+        if on_tpu and str(dtype).startswith(("f32", "float32")):
+            return ("fp32 compute on TPU (%s): the MFU above is "
+                    "measured against the derated fp32 peak; set "
+                    "MXTPU_AMP=bf16 to run forward/backward and "
+                    "collectives in bf16 with fp32 master weights "
+                    "(docs/performance.md \"Mixed precision\")" % kind)
+        return None
+    return None
+
+
 def _step_latency_percentiles(metrics):
     """p50/p99 of fit.step_seconds from the last metrics snapshot, using
     the same bucket interpolation as the live registry (the snapshot
@@ -220,6 +244,10 @@ def report(path, keep_all=False):
         diag += "; device model says the interval is %s-bound" % roof
     out += ["", diag]
 
+    amp = amp_advice(anatomy)
+    if amp:
+        out.append(amp)
+
     pcts = _step_latency_percentiles(metrics)
     if pcts:
         out.append("step latency p50=%.3f ms p99=%.3f ms (fit.step_seconds)"
@@ -234,7 +262,8 @@ def _self_test():
     d = tempfile.mkdtemp(prefix="perf_doctor_test_")
     path = os.path.join(d, "telemetry.jsonl")
 
-    def anatomy_rec(ivl, phases, unattr, mfu=None, bound=None):
+    def anatomy_rec(ivl, phases, unattr, mfu=None, bound=None,
+                    dtype=None, kind=None):
         rec = {"type": "anatomy", "interval": ivl, "steps": 10,
                "wall_seconds": sum(phases.values()) + unattr,
                "step_ms": 100.0 * (sum(phases.values()) + unattr),
@@ -244,6 +273,10 @@ def _self_test():
             rec["mfu"] = mfu
             rec["flops_per_step"] = 1e9
             rec["roofline"] = {"bound": bound or "compute"}
+        if dtype is not None:
+            rec["compute_dtype"] = dtype
+        if kind is not None:
+            rec["device_kind"] = kind
         return rec
 
     base = {"input_wait": 0.001, "stage_host": 0.002,
@@ -256,7 +289,8 @@ def _self_test():
         f.write(json.dumps(anatomy_rec(1, dict(base), 0.01,
                                        mfu=0.12)) + "\n")
         f.write(json.dumps(anatomy_rec(2, dict(base), 0.01, mfu=0.14,
-                                       bound="compute")) + "\n")
+                                       bound="compute", dtype="f32",
+                                       kind="TPU v5e")) + "\n")
         for shape in ([16, 8], [12, 8]):
             f.write(json.dumps({
                 "type": "recompile", "program": 0,
@@ -294,9 +328,18 @@ def _self_test():
     assert pcts is not None and 0.005 < pcts[0] <= 0.01, pcts
     assert 0.01 < pcts[1] <= 0.025, pcts
 
+    # AMP advice fires on (f32, TPU); stays silent for bf16 or CPU
+    assert "MXTPU_AMP=bf16" in (amp_advice(anatomy) or ""), anatomy
+    assert amp_advice([anatomy_rec(0, dict(base), 0.01, mfu=0.2,
+                                   dtype="bf16", kind="TPU v5e")]) is None
+    assert amp_advice([anatomy_rec(0, dict(base), 0.01, mfu=0.2,
+                                   dtype="f32", kind="cpu")]) is None
+    assert amp_advice([anatomy_rec(0, dict(base), 0.01)]) is None
+
     text = report(path)
     assert "diagnosis: largest cost is device_sync" in text, text
     assert "compute-bound" in text, text
+    assert "fp32 compute on TPU" in text, text
     assert "2x data.shape" in text, text
     assert "MFU trajectory" in text and "step anatomy" in text, text
     assert "p50=" in text and "p99=" in text, text

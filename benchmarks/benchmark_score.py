@@ -15,6 +15,7 @@ Smoke:     SCORE_SMOKE=1 python benchmarks/benchmark_score.py
 """
 from __future__ import annotations
 
+import glob
 import json
 import os
 import sys
@@ -231,6 +232,30 @@ def main():
             n_hidden=256 if SMOKE else 512,
             n_layers=3 if SMOKE else 6, reps=3 if SMOKE else 10)
         print(json.dumps(out["amp_ab"]), file=sys.stderr)
+    run_dir = os.environ.get("MXTPU_RUN_DIR")
+    if run_dir and glob.glob(os.path.join(run_dir, "telemetry_r*.jsonl")):
+        # ISSUE 16 rider: fleet skew next to MFU — when the bench ran
+        # under a launcher that left per-rank telemetry in MXTPU_RUN_DIR,
+        # fold the cross-rank skew decomposition into the same BENCH_*
+        # artifact so regressions in straggler behavior are tracked with
+        # the same cadence as throughput. Best-effort: a broken run dir
+        # must never fail the benchmark itself.
+        try:
+            from mxnet_tpu.telemetry.fleet import FleetAggregator
+
+            fsum = FleetAggregator(run_dir).refresh().summary()
+            out["fleet"] = {
+                "ranks": len(fsum.get("per_rank", {})),
+                "max_skew_ms": fsum.get("max_skew_ms"),
+                "median_skew_ms": fsum.get("median_skew_ms"),
+                "straggler": fsum.get("straggler"),
+                "bottleneck": fsum.get("bottleneck"),
+                # histogram of which rank was slowest per interval
+                "straggler_counts": fsum.get("straggler_counts", {}),
+            }
+            print(json.dumps({"fleet": out["fleet"]}), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — fleet view is advisory
+            out["fleet"] = {"error": str(e)}
     tag = os.environ.get("SCORE_TAG", "smoke" if SMOKE else "v5e_r4")
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "results", "benchmark_score_%s.json" % tag)

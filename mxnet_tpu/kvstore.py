@@ -73,6 +73,12 @@ _H_BUCKET_BYTES = _tm.histogram(
 _M_BUCKET_FLUSHES = _tm.counter(
     "kvstore.bucket_flushes", "GradBucketer flushes (one count per "
     "collective issued on the dist deferred-reduce queue)")
+# same name mesh.py uses for cross-process collectives — the registry
+# dedupes by name, so local reduces and gloo/jax collectives land in one
+# anatomy 'collective' phase
+_H_COLLECTIVE_SECONDS = _tm.histogram(
+    "parallel.collective_seconds",
+    "Wall time inside collective operations, by op")
 
 
 def _nbytes(vals):
@@ -284,6 +290,18 @@ class KVStore(object):
                         merged.copyto(self._store[k])
 
             if not self._is_dist:
+                # Single-process data-parallel: the cross-device reduce
+                # below IS this run's collective, so it must be visible
+                # to the anatomy 'collective' phase (the fleet view
+                # attributes skew through it). The fault point fires on
+                # the CALLER's thread and is timed into the same metric
+                # — an injected delay_collective_ms therefore lands in
+                # the collective phase, not smeared into dispatch.
+                tc = time.perf_counter()
+                _fault.fire("collective", key=k, local=True)
+                _H_COLLECTIVE_SECONDS.observe(
+                    time.perf_counter() - tc, op="local_reduce")
+
                 def _do_push(snap=snap, k=k, upd_key=upd_key):
                     t0 = time.perf_counter()
 
@@ -297,6 +315,8 @@ class KVStore(object):
                     # through a half-applied update would double-step
                     # momentum).
                     merged = _retry.call(_reduce_body, name="kv.push")
+                    _H_COLLECTIVE_SECONDS.observe(
+                        time.perf_counter() - t0, op="local_reduce")
                     _apply(merged, k, upd_key)
                     _H_PUSH_SECONDS.observe(time.perf_counter() - t0)
 

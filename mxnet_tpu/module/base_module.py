@@ -376,6 +376,32 @@ class BaseModule(object):
                     "next": 0.0,
                 }
 
+        # -- fleet liveness (telemetry/fleet.py, docs/observability.md) -
+        # Under a run dir every fitting process maintains hb_/prog_
+        # signal files, even with a local kvstore (dist kvstores start
+        # their own writer at creation — don't double up): the fleet
+        # aggregator, fleet_top, and the watchdog read per-rank liveness
+        # from these files.
+        fleet_hb = None
+        _fit_run_dir = os.environ.get("MXTPU_RUN_DIR")
+        if _fit_run_dir and getattr(
+                getattr(self, "_kvstore", None), "_heartbeat", None) is None:
+            try:
+                from ..parallel import heartbeat as _fleet_hb_mod
+
+                _rank = 0
+                for _var in ("DMLC_RANK", "JAX_PROCESS_ID"):
+                    if os.environ.get(_var):
+                        try:
+                            _rank = int(os.environ[_var])
+                            break
+                        except ValueError:
+                            pass
+                fleet_hb = _fleet_hb_mod.HeartbeatWriter(
+                    _fit_run_dir, _rank).start()
+            except OSError:
+                fleet_hb = None
+
         def _capture(epoch_next, nbatch_done):
             try:
                 metric_blob = pickle.dumps(eval_metric, protocol=2)
@@ -406,6 +432,8 @@ class BaseModule(object):
             loop["done"] = done
             loop["epoch"] = epoch
             _tm.anatomy.on_steps(n_new)
+            if fleet_hb is not None:
+                fleet_hb.progress(n_new)
             if ckpt_mgr is None:
                 return
             if preempt["flag"]:
@@ -470,6 +498,8 @@ class BaseModule(object):
                 _drain_metrics, _after_steps, ckpt_mgr, loop, _capture,
                 resume_skip, resume_metric)
         finally:
+            if fleet_hb is not None:
+                fleet_hb.stop()
             for _sig, handler in old_handlers.items():
                 try:
                     signal.signal(_sig, handler)

@@ -163,6 +163,17 @@ class HeartbeatWriter:
         self._stop.clear()  # writers are restartable (stop() then start())
         self._beat()
         self.progress()
+        try:
+            # the writer is the one long-lived per-rank presence in the
+            # run dir, so it also drops the clock handshake the fleet
+            # aggregator aligns timelines with (telemetry/fleet.py) —
+            # best-effort: absent telemetry package (standalone load)
+            # the per-rank JSONL sink writes it instead
+            from ..telemetry import export as _texport
+
+            _texport.write_clock_handshake(self._dir, self.rank)
+        except Exception:  # noqa: BLE001 — liveness must start regardless
+            pass
         self._thread = threading.Thread(
             target=self._loop, name="mxtpu-heartbeat", daemon=True)
         self._thread.start()

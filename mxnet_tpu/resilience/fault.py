@@ -162,7 +162,12 @@ def fire(point, **ctx):
         if ms > 0:
             time.sleep(ms / 1000.0)
         rl = spec.get("replica_lost")
+        # ctx local=True marks a single-process (local kvstore) reduce:
+        # there is no peer to wedge, so the lost rank must keep running
+        # until its own liveness goes stale — blocking here would hang
+        # the only process in the job.
         if (isinstance(rl, tuple) and _fired.get((raw, "replica_lost"))
+                and not ctx.get("local")
                 and os.environ.get("DMLC_RANK") == str(rl[0])):
             # The lost rank drops out of the fleet's collectives: block
             # here indefinitely, the way a preempted peer would — its

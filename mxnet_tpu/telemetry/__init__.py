@@ -26,6 +26,8 @@ See docs/observability.md.
 """
 from __future__ import annotations
 
+import os
+
 from . import export as _export
 from . import registry as _registry
 from .registry import (  # noqa: F401
@@ -40,20 +42,29 @@ from .export import (  # noqa: F401
 )
 from . import anatomy  # noqa: F401  (step anatomy / MFU / recompiles)
 from . import costmodel  # noqa: F401
+from . import fleet  # noqa: F401  (cross-rank aggregation + /metrics)
+from .fleet import FleetAggregator  # noqa: F401
 
 
-def enable(jsonl=None, prometheus=None, prometheus_interval=None):
+def enable(jsonl=None, prometheus=None, prometheus_interval=None,
+           metrics_port=None):
     """Turn collection on; optionally point the exporters at files.
 
     ``jsonl``: path for the structured JSONL stream (spans as they
     close, metrics snapshots on flush). ``prometheus``: path for the
     text dump, rewritten on flush and every ``prometheus_interval``
-    seconds (default 30)."""
+    seconds (default 30). ``metrics_port`` (or MXTPU_METRICS_PORT)
+    starts the localhost /metrics + /healthz HTTP endpoint. With
+    MXTPU_RUN_DIR set and no explicit jsonl path, records land in the
+    per-rank fleet sink ``<run_dir>/telemetry_r<rank>.jsonl``."""
     if jsonl is not None:
         _export.set_jsonl_path(jsonl)
     if prometheus is not None:
         _export.set_prometheus_file(prometheus, prometheus_interval)
     _registry.set_enabled(True)
+    _export.ensure_fleet_sink()
+    if metrics_port is not None or os.environ.get("MXTPU_METRICS_PORT"):
+        fleet.maybe_start_metrics_server(metrics_port)
 
 
 def disable():
@@ -75,3 +86,12 @@ def reset():
     _export.set_jsonl_path(None)
     _export.stop_prom_thread()
     _export.set_prometheus_file(None)
+    fleet.stop_metrics_server()
+
+
+# env-driven enablement at import (MXTPU_TELEMETRY=1): adopt the fleet
+# sink and, if MXTPU_METRICS_PORT asks, serve /metrics right away
+if _registry.enabled():
+    _export.ensure_fleet_sink()
+    if os.environ.get("MXTPU_METRICS_PORT"):
+        fleet.maybe_start_metrics_server()

@@ -259,6 +259,7 @@ def begin_loop():
         "totals": _phase_totals(),
         "steps": 0,
         "interval": 0,
+        "step_end": 0,
         "recompiles0": _C_RECOMPILES.value(),
     }
 
@@ -296,6 +297,9 @@ def emit_interval(force=False):
         "type": "anatomy",
         "t": time.time(),
         "interval": st["interval"],
+        # cumulative steps completed at interval close — the step id the
+        # fleet aggregator aligns cross-rank intervals on
+        "step_end": st["step_end"] + steps,
         "steps": steps,
         "wall_seconds": wall,
         "step_ms": 1000.0 * wall / steps,
@@ -337,6 +341,7 @@ def emit_interval(force=False):
     _export.emit_record(record)
     st["t0"] = now
     st["totals"] = totals
+    st["step_end"] += steps
     st["steps"] = 0
     st["interval"] += 1
     st["recompiles0"] += record["recompiles"]

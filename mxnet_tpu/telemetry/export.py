@@ -22,13 +22,112 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import socket
 import threading
 import time
 
 from . import registry as _reg
 
+RUN_DIR_ENV = "MXTPU_RUN_DIR"
+
 _lock = threading.Lock()
+_host = socket.gethostname()
+_seq = 0  # per-process metrics-snapshot sequence (fleet merge idempotence)
+_handshake_done = False
+
+
+def fleet_rank():
+    """This process's rank in the run: DMLC_RANK (launcher), else
+    JAX_PROCESS_ID (multi-host jax), else 0. Read per call — launchers
+    set it after import."""
+    for var in ("DMLC_RANK", "JAX_PROCESS_ID"):
+        val = os.environ.get(var)
+        if val:
+            try:
+                return int(val)
+            except ValueError:
+                pass
+    return 0
+
+
+def _tags_enabled():
+    # default ON: fleet aggregation needs every record to say who wrote it
+    return os.environ.get("MXTPU_RANK_TAGS", "1") not in ("", "0")
+
+
+def tag_record(record):
+    """Stamp rank/pid/host identity onto a JSONL record (copy, don't
+    mutate the caller's dict). MXTPU_RANK_TAGS=0 opts out."""
+    if not _tags_enabled():
+        return record
+    record = dict(record)
+    record.setdefault("rank", fleet_rank())
+    record.setdefault("pid", os.getpid())
+    record.setdefault("host", _host)
+    return record
+
+
+def _default_rank_sink():
+    """``<MXTPU_RUN_DIR>/telemetry_r<rank>.jsonl`` when a run dir is
+    configured (the fleet aggregator's discovery convention), else None."""
+    run_dir = os.environ.get(RUN_DIR_ENV)
+    if not run_dir:
+        return None
+    return os.path.join(run_dir, "telemetry_r%d.jsonl" % fleet_rank())
+
+
+def write_clock_handshake(run_dir=None, rank=None):
+    """Write ``clock_<rank>.json`` into the run dir: a paired
+    (wall-clock, monotonic) reading taken at write time. The aggregator
+    compares the file's mtime (stamped by the shared filesystem's
+    clock) against the recorded wall reading to place every rank's
+    timestamps on one timeline even when local clocks drift."""
+    run_dir = run_dir or os.environ.get(RUN_DIR_ENV)
+    if not run_dir:
+        return None
+    rank = fleet_rank() if rank is None else rank
+    path = os.path.join(run_dir, "clock_%d.json" % rank)
+    rec = {"rank": rank, "pid": os.getpid(), "host": _host,
+           "wall": time.time(), "mono": time.monotonic()}
+    try:
+        os.makedirs(run_dir, exist_ok=True)
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def _maybe_handshake():
+    """Write the clock handshake once per process, the first time the
+    JSONL sink is actually used with a run dir configured."""
+    global _handshake_done
+    if _handshake_done or not os.environ.get(RUN_DIR_ENV):
+        return
+    _handshake_done = True
+    write_clock_handshake()
+
+
+def ensure_fleet_sink():
+    """Adopt the per-rank run-dir sink if telemetry is enabled, a run
+    dir is set, and no explicit MXTPU_TELEMETRY_FILE overrode it; write
+    the clock handshake either way. Called from ``telemetry.enable()``."""
+    if not _reg._enabled:
+        return
+    if _jsonl_path is None and not os.environ.get("MXTPU_TELEMETRY_FILE"):
+        default = _default_rank_sink()
+        if default is not None:
+            set_jsonl_path(default)
+    _maybe_handshake()
+
+
 _jsonl_path = os.environ.get("MXTPU_TELEMETRY_FILE") or None
+if _jsonl_path is None and _reg._enabled:
+    # MXTPU_TELEMETRY=1 + MXTPU_RUN_DIR: land per-rank streams where the
+    # fleet aggregator looks, with no further configuration
+    _jsonl_path = _default_rank_sink()
 _jsonl_fh = None
 _prom_path = os.environ.get("MXTPU_TELEMETRY_PROM_FILE") or None
 _prom_interval = float(os.environ.get("MXTPU_TELEMETRY_PROM_INTERVAL", "30"))
@@ -64,7 +163,8 @@ def _fh():
 def emit_span(record):
     if _jsonl_path is None:
         return
-    line = json.dumps(record)
+    _maybe_handshake()
+    line = json.dumps(tag_record(record))
     with _lock:
         fh = _fh()
         if fh is not None:
@@ -80,11 +180,16 @@ emit_record = emit_span
 def flush_metrics():
     """Append a registry snapshot to the JSONL sink and rewrite the
     Prometheus file, whichever are configured."""
+    global _seq
     if _jsonl_path is not None:
-        line = json.dumps({
-            "type": "metrics", "ts": time.time(),
+        _maybe_handshake()
+        with _lock:
+            _seq += 1
+            seq = _seq
+        line = json.dumps(tag_record({
+            "type": "metrics", "ts": time.time(), "seq": seq,
             "metrics": _reg.snapshot(),
-        })
+        }))
         with _lock:
             fh = _fh()
             if fh is not None:

@@ -232,12 +232,43 @@ def percentile_from_counts(buckets, counts, count, total_sum, q):
     return float(buckets[-1]) if buckets else float(total_sum) / count
 
 
+def union_edges(a, b):
+    """Sorted union of two bucket-edge tuples (cross-process histogram
+    merge: ranks may run different bucket-edge generations)."""
+    return tuple(sorted(set(a) | set(b)))
+
+
+def rebucket_counts(counts, src_edges, dst_edges):
+    """Re-express histogram ``counts`` (len(src_edges)+1, trailing +Inf
+    bucket) on ``dst_edges``, which must be a superset of ``src_edges``.
+
+    A source bucket ``(src[i-1], src[i]]`` maps onto the destination
+    bucket whose upper edge is the SAME ``src[i]`` — i.e. all mass
+    inside a source bucket is attributed to the top of that bucket.
+    Cumulative counts at every *source* edge are therefore preserved
+    exactly; at edges the destination inserted inside a source bucket
+    the cumulative count is a lower bound, so
+    :func:`percentile_from_counts` on the merged state is exact at
+    source edges and off by at most one source bucket width elsewhere.
+    """
+    pos = {float(e): i for i, e in enumerate(dst_edges)}
+    out = [0] * (len(dst_edges) + 1)
+    for i, edge in enumerate(src_edges):
+        c = counts[i]
+        if c:
+            out[pos[float(edge)]] += c
+    out[-1] += counts[-1]  # +Inf bucket maps to +Inf bucket
+    return out
+
+
 class Registry:
     """Name -> metric map with get-or-create accessors."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics = {}
+        # rank -> highest snapshot seq merged so far (merge_snapshot)
+        self._merge_seq = {}
 
     def _get_or_create(self, cls, name, help, **kwargs):
         with self._lock:
@@ -338,6 +369,83 @@ class Registry:
                     lines.append("%s%s %s" % (
                         name, _prom_labels(key), _prom_float(val)))
         return "\n".join(lines) + "\n"
+
+    # -- cross-process merge -------------------------------------------
+    def merge_snapshot(self, snap, rank=None, seq=None):
+        """Fold one rank's :meth:`snapshot` dump into THIS registry.
+
+        Intended for private aggregator registries (the fleet plane),
+        not the live process registry: it writes stream state directly,
+        bypassing the ``_enabled`` fast path and the instrument API.
+
+        Semantics:
+
+        * Snapshots are **cumulative** registry dumps, so a newer
+          snapshot from the same rank REPLACES that rank's streams
+          (per metric) rather than adding to them.
+        * When ``rank`` is given, every merged stream gains a
+          ``rank`` label, and the merge is **idempotent per
+          (rank, seq)**: a snapshot whose ``seq`` is not strictly
+          greater than the last one merged for that rank is a no-op
+          (returns False). Replayed or reordered JSONL tails therefore
+          cannot double-count.
+        * Histogram streams from ranks with different bucket-edge
+          generations merge by edge-set union: the target metric's
+          edges grow to the union and existing streams are rebucketed
+          via :func:`rebucket_counts` (exact at source edges,
+          conservative at inserted ones).
+        """
+        rank_key = None if rank is None else str(rank)
+        if rank_key is not None and seq is not None:
+            with self._lock:
+                if seq <= self._merge_seq.get(rank_key, -1):
+                    return False
+                self._merge_seq[rank_key] = seq
+        for name, entry in snap.items():
+            kind = entry.get("kind", "untyped")
+            streams = entry.get("streams", [])
+            if kind == "histogram":
+                edges = DEFAULT_BUCKETS
+                for s in streams:
+                    if s.get("buckets"):
+                        edges = tuple(sorted(s["buckets"]))
+                        break
+                m = self.histogram(name, buckets=edges)
+            elif kind == "counter":
+                m = self.counter(name)
+            else:
+                m = self.gauge(name)
+            with m._lock:
+                if rank_key is not None:
+                    stale = [k for k in m._values
+                             if ("rank", rank_key) in k]
+                    for k in stale:
+                        del m._values[k]
+                for s in streams:
+                    labels = dict(s.get("labels", {}))
+                    if rank_key is not None:
+                        labels["rank"] = rank_key
+                    key = _label_key(labels)
+                    if kind != "histogram":
+                        m._values[key] = s.get("value", 0)
+                        continue
+                    src_edges = tuple(sorted(s.get("buckets", m.buckets)))
+                    counts = list(s.get("counts", []))
+                    if src_edges != m.buckets:
+                        dst = union_edges(m.buckets, src_edges)
+                        if dst != m.buckets:
+                            for st in m._values.values():
+                                st["counts"] = rebucket_counts(
+                                    st["counts"], m.buckets, dst)
+                            m.buckets = dst
+                        counts = rebucket_counts(counts, src_edges,
+                                                 m.buckets)
+                    m._values[key] = {
+                        "counts": counts,
+                        "sum": float(s.get("sum", 0.0)),
+                        "count": int(s.get("count", 0)),
+                    }
+        return True
 
 
 def _prom_name(name):

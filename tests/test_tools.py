@@ -204,6 +204,39 @@ def test_watchdog_decision_table_rows():
         watchdog.EXIT_RESHAPE, [3], 2, 2, 8, False) == ("fail", 8)
 
 
+def test_fleet_top_cli_self_test():
+    """Synthetic 3-rank run dir -> straggler table + Prometheus format
+    checker (accepts merged registry output, rejects malformed text)."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.fleet_top", "--self-test"],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "self-test passed" in res.stdout
+
+
+def test_fleet_top_prometheus_checker():
+    from tools import fleet_top
+
+    good = ("# HELP h help text\n"
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 5\n'
+            "h_sum 7.5\nh_count 5\n"
+            "# TYPE g gauge\n"
+            'g{rank="0"} 1.25e-3\n')
+    assert fleet_top.check_prometheus_text(good) == []
+    # malformed sample line
+    assert fleet_top.check_prometheus_text('metric{le="x} 1\n')
+    # non-cumulative buckets
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\nh_count 3\n')
+    assert fleet_top.check_prometheus_text(bad)
+    # +Inf bucket must be present and equal _count
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="1"} 3\nh_count 3\n')
+    assert fleet_top.check_prometheus_text(bad)
+
+
 def test_perf_doctor_cli_self_test():
     repo = os.path.join(os.path.dirname(__file__), "..")
     res = subprocess.run(

@@ -17,7 +17,15 @@ Usage::
 
     python -m tools.perf_doctor telemetry.jsonl
     python -m tools.perf_doctor telemetry.jsonl --all-intervals
+    python -m tools.perf_doctor RUN_DIR          # multi-rank fleet view
     python -m tools.perf_doctor --self-test
+
+Pointed at a run dir (or at one rank's stream inside a run dir that
+holds several ``telemetry_r<k>.jsonl`` files), the report grows a
+"fleet" section fed from the fleet aggregator
+(``mxnet_tpu/telemetry/fleet.py``): slowest-rank ranking, per-interval
+skew trend, and straggler advice — the single-stream diagnosis below it
+then covers the straggler's own stream.
 
 The first interval of a run usually carries the warmup compile inside
 its unattributed time; it is dropped from the diagnosis by default
@@ -207,9 +215,76 @@ def _step_latency_percentiles(metrics):
                                         agg_sum, q) for q in (50, 99))
 
 
+def fleet_section(run_dir):
+    """The cross-rank block of the report, fed from the fleet
+    aggregator (never re-parsed here): slowest-rank ranking, skew
+    trend, straggler advice. None when the run dir holds fewer than two
+    rank streams."""
+    from mxnet_tpu.telemetry import fleet as _fleet
+
+    agg = _fleet.FleetAggregator(run_dir).refresh()
+    summary = agg.summary()
+    if len(summary["ranks"]) < 2:
+        return None, None
+    out = ["== fleet (%d ranks) ==" % len(summary["ranks"])]
+    ranking = sorted(
+        summary["per_rank"].items(),
+        key=lambda kv: -(kv[1]["step_ms"] or 0.0))
+    for rank, pr in ranking:
+        flags = []
+        if rank == summary.get("straggler"):
+            flags.append("STRAGGLER")
+        if pr.get("lost"):
+            flags.append("LOST")
+        if pr.get("stalled"):
+            flags.append("STALLED")
+        out.append(
+            "  rank %-3d %8.1f ms/step  mfu %-6s feed %6.1f ms/step  "
+            "recompiles %-3d %s" % (
+                rank, pr["step_ms"] or 0.0,
+                ("%.3f" % pr["mfu"]) if pr["mfu"] is not None else "-",
+                pr["feed_wait_ms_per_step"] or 0.0,
+                pr["recompiles"], " ".join(flags)))
+    for line in agg.advice():
+        out.append("  " + line)
+    return "\n".join(out), summary
+
+
 def report(path, keep_all=False):
+    fleet_text = None
+    if os.path.isdir(path):
+        # run dir: fleet section + the straggler's own stream below it
+        try:
+            fleet_text, summary = fleet_section(path)
+        except Exception as exc:  # noqa: BLE001 — fleet view is advisory
+            fleet_text, summary = "== fleet ==\n  unavailable: %s" % exc, \
+                None
+        streams = sorted(
+            f for f in os.listdir(path)
+            if f.startswith("telemetry_r") and f.endswith(".jsonl"))
+        if not streams:
+            return (fleet_text or
+                    "no telemetry_r*.jsonl streams in %s" % path)
+        pick = "telemetry_r%d.jsonl" % summary["straggler"] \
+            if summary and summary.get("straggler") is not None \
+            else streams[0]
+        out = [fleet_text] if fleet_text else []
+        out.append("")
+        out.append("-- single-stream diagnosis: %s --" % pick)
+        out.append(report(os.path.join(path, pick), keep_all=keep_all))
+        return "\n".join(out)
+    run_dir = os.path.dirname(os.path.abspath(path))
+    siblings = [f for f in os.listdir(run_dir)
+                if f.startswith("telemetry_r") and f.endswith(".jsonl")]
+    if len(siblings) > 1:
+        try:
+            fleet_text, _ = fleet_section(run_dir)
+        except Exception:  # noqa: BLE001
+            fleet_text = None
     anatomy, recompiles, metrics = load_records(path)
     out = ["== step anatomy ==", format_anatomy(anatomy)]
+    if fleet_text:
+        out = [fleet_text, ""] + out
     if not anatomy:
         return "\n".join(out)
 
@@ -350,6 +425,37 @@ def _self_test():
         f.write(json.dumps({"type": "span", "name": "x", "ts": 0,
                             "dur": 1}) + "\n")
     assert "no anatomy records" in report(empty)
+
+    # -- fleet section over a multi-rank run dir ------------------------
+    run = os.path.join(d, "run")
+    os.makedirs(run)
+    for rank in range(3):
+        slow = 0.2 if rank == 1 else 0.0
+        with open(os.path.join(run, "telemetry_r%d.jsonl" % rank),
+                  "w") as f:
+            for ivl in range(3):
+                phases = dict(base)
+                phases["input_wait"] += slow
+                wall = sum(phases.values()) + 0.01
+                f.write(json.dumps({
+                    "type": "anatomy", "interval": ivl,
+                    "step_end": (ivl + 1) * 10, "steps": 10,
+                    "rank": rank, "pid": 100 + rank, "host": "h",
+                    "wall_seconds": wall, "step_ms": 100.0 * wall,
+                    "phases": phases, "unattributed_seconds": 0.01,
+                    "recompiles": 0}) + "\n")
+    fleet_report = report(run)
+    assert "== fleet (3 ranks) ==" in fleet_report, fleet_report
+    assert "rank 1 is input-bound" in fleet_report, fleet_report
+    assert "STRAGGLER" in fleet_report, fleet_report
+    assert "single-stream diagnosis: telemetry_r1.jsonl" in fleet_report, \
+        fleet_report
+    assert "skew trend" in fleet_report, fleet_report
+    # pointing at ONE rank's stream inside the same run dir also grows
+    # the fleet section above the single-stream diagnosis
+    one = report(os.path.join(run, "telemetry_r0.jsonl"))
+    assert "== fleet (3 ranks) ==" in one, one
+    assert "== step anatomy ==" in one, one
     print("self-test passed")
     return 0
 

@@ -14,7 +14,10 @@ time (first start to last end). Usage::
 
     python -m tools.trace_summary profile.json
     python -m tools.trace_summary telemetry.jsonl --top 15
+    python -m tools.trace_summary 'run_dir/telemetry_r*.jsonl'
     python -m tools.trace_summary telemetry.jsonl --anatomy
+    python -m tools.trace_summary --merge 'run_dir/trace_r*.json' \
+        --out merged.json
     python -m tools.trace_summary --self-test
 
 ``--anatomy`` renders the step-anatomy intervals
@@ -22,11 +25,21 @@ time (first start to last end). Usage::
 phase breakdown, explicit unattributed remainder, MFU, and roofline
 bound per interval. ``tools/perf_doctor.py`` builds a diagnosis on top
 of the same records.
+
+Paths accept globs (quoted so the shell doesn't expand them); several
+files aggregate into one table. ``--merge`` combines per-rank chrome
+traces (``trace_r<k>.json``) into a single chrome://tracing file with
+one ``pid`` lane per rank, shifting each rank's timestamps by the
+run dir's ``clock_<rank>.json`` handshake offset so the lanes share one
+timeline.
 """
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
+import os
+import re
 import sys
 
 
@@ -257,6 +270,119 @@ def summarize(path, top=0):
     return text
 
 
+def expand_paths(patterns):
+    """Glob-expand each pattern (sorted); a pattern with no hits passes
+    through so open() reports the missing file by name."""
+    out = []
+    for pat in patterns:
+        hits = sorted(_glob.glob(pat))
+        out.extend(hits if hits else [pat])
+    return out
+
+
+def summarize_many(paths, top=0):
+    """One aggregated table over several telemetry/trace files (a
+    glob'd multi-rank run dir): span rows and collective bytes sum
+    across files; wall is the widest single file (streams overlap in
+    time, so summing walls would double-count)."""
+    if len(paths) == 1:
+        return summarize(paths[0], top=top)
+    agg, coll_all = {}, {}
+    wall_max = 0.0
+    any_rows = False
+    for path in paths:
+        rows, wall, _, coll = load(path)
+        any_rows = any_rows or bool(rows)
+        wall_max = max(wall_max, wall)
+        for n, t, c in rows:
+            tot, cnt = agg.get(n, (0.0, 0))
+            agg[n] = (tot + t, cnt + c)
+        for n, (t, c, b) in coll.items():
+            tot, cnt, byt = coll_all.get(n, (0.0, 0, 0))
+            coll_all[n] = (tot + t, cnt + c, byt + b)
+    if not any_rows:
+        return "no span/event records in %d file(s)" % len(paths)
+    text = "%d files aggregated\n" % len(paths)
+    text += format_table([(n, t, c) for n, (t, c) in agg.items()],
+                         wall_max, top=top)
+    if coll_all:
+        text += "\n" + format_collectives(coll_all)
+    return text
+
+
+# ---------------------------------------------------------------------------
+# multi-rank trace merge
+# ---------------------------------------------------------------------------
+
+_TRACE_RANK_RE = re.compile(r"trace_r(\d+)\.json$")
+
+
+def _rank_of(path):
+    m = _TRACE_RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _clock_offsets(run_dir):
+    """rank -> seconds to ADD to that rank's timestamps, from the
+    ``clock_<rank>.json`` handshakes (mxnet_tpu/telemetry/fleet.py
+    semantics: file mtime is the shared filesystem's clock, the recorded
+    ``wall`` is the rank's — the difference aligns drifting clocks)."""
+    offsets = {}
+    for path in _glob.glob(os.path.join(run_dir, "clock_*.json")):
+        m = re.search(r"clock_(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            offsets[int(m.group(1))] = (
+                os.path.getmtime(path) - float(data["wall"]))
+        except (OSError, ValueError, KeyError):
+            continue
+    return offsets
+
+
+def merge_traces(paths, out_path):
+    """Merge per-rank chrome traces into ONE chrome://tracing file.
+
+    Every event of rank k lands in lane ``pid``=k (with a
+    ``process_name`` metadata event naming it), and its timestamps are
+    shifted by the clock-offset handshake so all lanes share one
+    timeline. Returns (number of traces merged, total events).
+    """
+    merged = []
+    n_traces = 0
+    for idx, path in enumerate(paths):
+        rank = _rank_of(path)
+        rank = idx if rank is None else rank
+        offsets = _clock_offsets(os.path.dirname(path) or ".")
+        shift_us = offsets.get(rank, 0.0) * 1e6
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
+            else doc
+        n_traces += 1
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "tid": 0, "args": {"name": "rank %d" % rank}})
+        merged.append({"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "tid": 0,
+                       "args": {"sort_index": rank}})
+        for e in events:
+            if not isinstance(e, dict):
+                continue
+            if e.get("ph") == "M" and e.get("name") in (
+                    "process_name", "process_sort_index"):
+                continue  # replaced by the per-rank lane metadata above
+            e = dict(e)
+            e["pid"] = rank
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + shift_us
+            merged.append(e)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    return n_traces, len(merged)
+
+
 def _self_test():
     """Exercise both readers on synthetic files; raises on mismatch."""
     import os
@@ -364,31 +490,92 @@ def _self_test():
         total = sum(r["phases"].values()) + r["unattributed_seconds"]
         assert abs(total - r["wall_seconds"]) < 1e-9, r
     assert "no anatomy records" in format_anatomy([])
+
+    # -- multi-rank merge: pid lanes + clock-offset shift ---------------
+    run = os.path.join(d, "run")
+    os.makedirs(run)
+    for rank in (0, 1):
+        with open(os.path.join(run, "trace_r%d.json" % rank), "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 0, "args": {}},
+                {"name": "step", "ph": "X", "ts": 1000.0, "dur": 100.0,
+                 "pid": 0, "tid": 1},
+            ]}, f)
+    # rank 1's clock runs 2s behind the filesystem's: handshake wall is
+    # 2s older than the file mtime -> offset +2s
+    now = __import__("time").time()
+    for rank, skew in ((0, 0.0), (1, 2.0)):
+        cp = os.path.join(run, "clock_%d.json" % rank)
+        with open(cp, "w") as f:
+            json.dump({"rank": rank, "wall": now - skew, "mono": 0.0}, f)
+        os.utime(cp, (now, now))
+    out = os.path.join(d, "merged.json")
+    n, _ = merge_traces(
+        expand_paths([os.path.join(run, "trace_r*.json")]), out)
+    assert n == 2, n
+    with open(out) as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert sorted(e["pid"] for e in xs) == [0, 1], xs
+    by_pid = {e["pid"]: e for e in xs}
+    assert abs(by_pid[0]["ts"] - 1000.0) < 1e4, by_pid  # ~no offset
+    # rank 1 shifted by ~2s (2e6 us) onto the shared timeline
+    assert abs(by_pid[1]["ts"] - by_pid[0]["ts"] - 2e6) < 1e4, by_pid
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert names == ["rank 0", "rank 1"], names
+    # merged file is a normal chrome trace: the summary reader takes it
+    rows3, _, _, _ = load(out)
+    assert dict((n_, (t, c)) for n_, t, c in rows3)["step"][1] == 2, rows3
+
+    # -- glob summary aggregates across per-rank files ------------------
+    text2 = summarize_many(
+        expand_paths([os.path.join(run, "trace_r*.json")]))
+    assert "2 files aggregated" in text2 and "step" in text2, text2
+
     print("self-test passed")
     return 0
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
-        description="Summarize a chrome trace or telemetry JSONL file")
-    parser.add_argument("path", nargs="?",
-                        help="profile.json or telemetry .jsonl")
+        description="Summarize chrome traces / telemetry JSONL files "
+                    "(paths accept globs), or merge per-rank traces")
+    parser.add_argument("paths", nargs="*",
+                        help="profile.json / telemetry .jsonl / glob")
     parser.add_argument("--top", type=int, default=0,
                         help="show only the N most expensive phases")
     parser.add_argument("--anatomy", action="store_true",
                         help="show the step-anatomy interval table "
                              "(telemetry JSONL only)")
+    parser.add_argument("--merge", metavar="GLOB",
+                        help="merge per-rank chrome traces "
+                             "(trace_r<k>.json) into --out, one pid "
+                             "lane per rank, clock offsets applied")
+    parser.add_argument("--out", default="trace_merged.json",
+                        help="output path for --merge "
+                             "(default: trace_merged.json)")
     parser.add_argument("--self-test", action="store_true",
                         help="run built-in checks on synthetic inputs")
     args = parser.parse_args(argv)
     if args.self_test:
         return _self_test()
-    if not args.path:
-        parser.error("path required (or --self-test)")
-    if args.anatomy:
-        print(format_anatomy(load_anatomy(args.path)))
+    if args.merge:
+        paths = expand_paths([args.merge])
+        n, events = merge_traces(paths, args.out)
+        print("merged %d trace(s), %d events -> %s"
+              % (n, events, args.out))
         return 0
-    print(summarize(args.path, top=args.top))
+    if not args.paths:
+        parser.error("path required (or --merge / --self-test)")
+    paths = expand_paths(args.paths)
+    if args.anatomy:
+        for path in paths:
+            if len(paths) > 1:
+                print("== %s" % path)
+            print(format_anatomy(load_anatomy(path)))
+        return 0
+    print(summarize_many(paths, top=args.top))
     return 0
 
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import glob
+import json
 import os
 import re
 import signal
@@ -105,6 +106,46 @@ def decide(rc, lost, restarts, max_restarts, world, elastic):
     if restarts < max_restarts:
         return ("retry", world)
     return ("fail", world)
+
+
+def fleet_evidence(run_dir):
+    """Cross-rank evidence for a decision record: straggler + bottleneck
+    attribution, skew, per-rank liveness — from the fleet aggregator
+    (mxnet_tpu/telemetry/fleet.py) over the per-rank telemetry streams
+    in the run dir. Purely advisory: when no rank wrote telemetry the
+    aggregator is never imported and the record just says so."""
+    out = {"telemetry_ranks": 0}
+    if not run_dir or not os.path.isdir(run_dir):
+        return out
+    if not glob.glob(os.path.join(run_dir, "telemetry_r*.jsonl")):
+        return out
+    try:
+        from mxnet_tpu.telemetry import fleet as _fleet
+
+        out = _fleet.FleetAggregator(run_dir).refresh().evidence()
+    except Exception as exc:  # noqa: BLE001 — evidence must not kill
+        out["aggregator_error"] = str(exc)  # the supervisor
+    return out
+
+
+def _record_decision(run_dir, action, rc, stalled, lost, restarts, world,
+                     new_world):
+    """Append one ``{"type": "decision"}`` line to
+    ``<run_dir>/decisions.jsonl`` — every supervision outcome carries
+    the aggregated per-rank evidence that justified it."""
+    if not run_dir:
+        return
+    record = {
+        "type": "decision", "t": time.time(), "action": action,
+        "rc": rc, "stalled": bool(stalled), "lost": sorted(lost),
+        "restarts": restarts, "world": world, "new_world": new_world,
+        "evidence": fleet_evidence(run_dir),
+    }
+    try:
+        with open(os.path.join(run_dir, "decisions.jsonl"), "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        pass
 
 
 def supervise(command, max_restarts=2, num_workers=0,
@@ -202,6 +243,8 @@ def supervise(command, max_restarts=2, num_workers=0,
                     break
             time.sleep(poll_interval)
         if rc == 0 and not stalled:
+            _record_decision(run_dir, "done", 0, False, [], restarts,
+                             world or 0, world or 0)
             if own_run_dir:
                 shutil.rmtree(own_run_dir, ignore_errors=True)
             return 0
@@ -211,6 +254,8 @@ def supervise(command, max_restarts=2, num_workers=0,
         action, new_world = decide(rc if not stalled else (rc or 1),
                                    lost, restarts, max_restarts,
                                    world or 0, elastic)
+        _record_decision(run_dir, action, rc, stalled, lost, restarts,
+                         world or 0, new_world)
         if action == "shrink":
             log("[watchdog] elastic shrink: rank(s) %s lost, restarting "
                 "at world %d (was %d)" % (lost, new_world, world))
@@ -278,6 +323,19 @@ def _self_test():
         joined = "\n".join(msgs)
         assert rc == 0, (rc, joined)
         assert "elastic shrink" in joined and "world 3" in joined, joined
+
+        # every outcome left a decision record with attached evidence
+        with open(os.path.join(tmp, "run", "decisions.jsonl")) as f:
+            decisions = [json.loads(line) for line in f if line.strip()]
+        actions = [d["action"] for d in decisions]
+        assert actions == ["shrink", "done"], actions
+        assert decisions[0]["lost"] == [2], decisions[0]
+        assert decisions[0]["world"] == 4, decisions[0]
+        assert decisions[0]["new_world"] == 3, decisions[0]
+        assert all("evidence" in d for d in decisions), decisions
+        # no rank wrote telemetry in the stub job: evidence says so
+        # (and the aggregator was never imported)
+        assert decisions[0]["evidence"]["telemetry_ranks"] == 0, decisions[0]
 
         # -- end-to-end: transient exit 75 retries same-size ------------
         script2 = os.path.join(tmp, "job2.py")

@@ -232,6 +232,17 @@ def main():
             n_hidden=256 if SMOKE else 512,
             n_layers=3 if SMOKE else 6, reps=3 if SMOKE else 10)
         print(json.dumps(out["amp_ab"]), file=sys.stderr)
+    if os.environ.get("SCORE_CONV", "0") == "1":
+        # ISSUE 17 rider: per-shape XLA-vs-Pallas-vs-taps conv-backward
+        # table through the real ops/nn.py dispatch — the tuned-envelope
+        # speedup AND the untuned-shape fallback proof land in the same
+        # BENCH artifact (full sweep in benchmarks/conv_bwd_experiments
+        # --score)
+        from benchmarks.conv_bwd_experiments import run_conv_score
+
+        out["conv"] = run_conv_score(jax, jnp, smoke=SMOKE or not on_tpu)
+        print(json.dumps({"conv_rows": len(out["conv"]["rows"])}),
+              file=sys.stderr)
     run_dir = os.environ.get("MXTPU_RUN_DIR")
     if run_dir and glob.glob(os.path.join(run_dir, "telemetry_r*.jsonl")):
         # ISSUE 16 rider: fleet skew next to MFU — when the bench ran

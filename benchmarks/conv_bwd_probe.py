@@ -279,6 +279,8 @@ def main():
     # (the exit-3 wedge path only covers errors the process itself sees)
     import signal as _signal
 
+    from mxnet_tpu.resilience.checkpoint import atomic_file as _atomic
+
     def _on_term(signum, frame):
         snap = {
             "batch": BATCH, "scan_k": SCAN_K,
@@ -291,8 +293,15 @@ def main():
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "results", "conv_bwd_probe_%s.json" % tag)
         try:
-            with open(path, "w") as f:
+            # NOTES_r5 §11: a plain open/json.dump here raced os._exit —
+            # the queue reaper read back a TRUNCATED json after exit 3.
+            # tmp + fsync + rename (resilience's atomic_file) makes the
+            # handler's snapshot all-or-nothing; a failed write leaves
+            # the previous incremental flush intact.
+            with _atomic(path, mode="w") as f:
                 json.dump(snap, f, indent=1)
+        except Exception:  # noqa: BLE001 — the exit code must survive
+            pass
         finally:
             os._exit(3)
 
@@ -315,10 +324,8 @@ def main():
             "partial_reason": "in progress (incremental flush; a "
                               "complete run overwrites this)",
         }
-        tmp = result_path + ".tmp"
-        with open(tmp, "w") as f:
+        with _atomic(result_path, mode="w") as f:
             json.dump(snap, f, indent=1)
-        os.replace(tmp, result_path)
 
     partial_reason = None
     try:
@@ -388,7 +395,7 @@ def main():
         _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)  # full write
     except (ValueError, OSError):
         pass
-    with open(result_path, "w") as f:
+    with _atomic(result_path, mode="w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"written": result_path, **summary}))
     if partial_reason:

@@ -78,6 +78,130 @@ def _check_input_names(symbol, names, typename, throw):
         logging.warning(msg)
 
 
+class _MultistepAutoTuner:
+    """``MXNET_FIT_MULTISTEP=auto``: grow the fused-step scan depth K
+    until host dispatch is invisible next to device time.
+
+    After each full K-group the tuner reads the async-pipeline phase
+    totals (``module.dispatch_host_seconds`` et al — the same counters
+    the anatomy record reports) and estimates the dispatch share of the
+    group's wall time using the anatomy's disjointness rule (dispatch
+    minus its staging sub-window, clamped at zero; device time is the
+    wall remainder after every host phase). While the share exceeds
+    ``MXTPU_DISPATCH_TARGET_FRAC`` (default 0.05) and K <
+    ``MXNET_FIT_MULTISTEP_MAX`` (default 32), K doubles. Each doubling
+    costs exactly one recompile, and the first group at each depth is
+    excluded from measurement so compile time never pollutes the
+    estimate. Once the target is met (or the cap is hit) the tuner
+    settles: K is frozen, every later group re-dispatches the same
+    compiled K-scan, and the steady state recompiles zero times.
+
+    Decisions land in the telemetry JSONL as ``type=multistep_auto``
+    records, and the current depth is stamped onto every anatomy
+    interval record via :func:`telemetry.anatomy.note_multistep`."""
+
+    _KEYS = {"dispatch": "module.dispatch_host_seconds",
+             "stage": "module.stage_host_seconds",
+             "input": "io.feed_wait_seconds"}
+
+    def __init__(self, logger=None):
+        def _env(name, default, cast):
+            try:
+                return cast(os.environ.get(name, default))
+            except ValueError:
+                return cast(default)
+
+        self.target = _env("MXTPU_DISPATCH_TARGET_FRAC", "0.05", float)
+        self.k_max = max(1, _env("MXNET_FIT_MULTISTEP_MAX", "32", int))
+        # measure at least this many steps per decision so one noisy
+        # group can't trigger a doubling
+        self.min_steps = max(
+            1, _env("MXTPU_MULTISTEP_AUTO_STEPS", "8", int))
+        self.k = min(2, self.k_max)
+        self.settled = self.k >= self.k_max
+        self.logger = logger
+        self.last_frac = None
+        self._skip = True
+        self._steps = 0
+        self._base = None
+        self._t0 = None
+        _tm.anatomy.note_multistep(self.k, settled=self.settled)
+
+    def _totals(self):
+        from ..telemetry import registry as _reg
+
+        return {k: _reg.REGISTRY.total(v) for k, v in self._KEYS.items()}
+
+    def _arm(self):
+        self._base = self._totals()
+        self._t0 = time.perf_counter()
+        self._steps = 0
+
+    def after_group(self, k_done):
+        """Called by the fit loop after each full K-group dispatch."""
+        if self.settled or k_done != self.k:
+            return
+        if not _tm.enabled():
+            # no phase counters to steer by: freeze at the initial depth
+            self._settle(None, "telemetry disabled")
+            return
+        if self._skip:
+            # the first group at this depth carries the K-scan compile;
+            # start measuring from the next one
+            self._skip = False
+            self._arm()
+            return
+        self._steps += k_done
+        if self._steps < self.min_steps:
+            return
+        now = self._totals()
+        wall = max(time.perf_counter() - self._t0, 1e-9)
+        disp = now["dispatch"] - self._base["dispatch"]
+        stage = now["stage"] - self._base["stage"]
+        feed = now["input"] - self._base["input"]
+        # anatomy's disjointness rule: the dispatch measurement window
+        # includes staging, so subtract it; device-side time is what is
+        # left of wall after every host phase
+        disp_adj = max(disp - stage, 0.0)
+        device = max(wall - feed - stage - disp_adj, 1e-9)
+        frac = disp_adj / device
+        self.last_frac = frac
+        if frac <= self.target:
+            self._settle(frac, "target met")
+        elif self.k >= self.k_max:
+            self._settle(frac, "depth cap")
+        else:
+            self.k = min(self.k * 2, self.k_max)
+            self._skip = True
+            self._record(frac, grown=True)
+            if self.logger is not None:
+                self.logger.info(
+                    "fit multistep auto: dispatch %.1f%% of device time "
+                    "> %.1f%% target, growing K to %d",
+                    100 * frac, 100 * self.target, self.k)
+
+    def _settle(self, frac, why):
+        self.settled = True
+        self._record(frac, grown=False, why=why)
+        if self.logger is not None:
+            self.logger.info(
+                "fit multistep auto: settled at K=%d (%s%s)", self.k, why,
+                "" if frac is None
+                else ", dispatch at %.1f%% of device time" % (100 * frac))
+
+    def _record(self, frac, grown, why=None):
+        _tm.anatomy.note_multistep(self.k, settled=self.settled,
+                                   dispatch_frac=frac)
+        rec = {"type": "multistep_auto", "k": self.k,
+               "settled": self.settled, "grown": grown,
+               "target_frac": self.target}
+        if frac is not None:
+            rec["dispatch_frac"] = round(frac, 4)
+        if why:
+            rec["why"] = why
+        _tm.anatomy.emit_decision(rec)
+
+
 class BaseModule(object):
     def __init__(self, logger=logging):
         self.logger = logger
@@ -247,10 +371,19 @@ class BaseModule(object):
         # host dispatch overhead the way the reference's threaded engine
         # hides it (threaded_engine_perdevice.cc:26-136). Metric updates
         # and batch callbacks still fire once per batch, after the group.
-        try:
-            fit_k = int(os.environ.get("MXNET_FIT_MULTISTEP", "1"))
-        except ValueError:
-            fit_k = 1
+        # MXNET_FIT_MULTISTEP=auto hands depth selection to the tuner:
+        # K starts at 2 and doubles until dispatch_host is below
+        # MXTPU_DISPATCH_TARGET_FRAC of device time, then freezes.
+        auto_tuner = None
+        _fit_k_raw = os.environ.get("MXNET_FIT_MULTISTEP", "1")
+        if _fit_k_raw.strip().lower() == "auto":
+            auto_tuner = _MultistepAutoTuner(self.logger)
+            fit_k = auto_tuner.k
+        else:
+            try:
+                fit_k = int(_fit_k_raw)
+            except ValueError:
+                fit_k = 1
 
         # -- preemption-safe checkpointing (resilience/) ---------------
         from ..resilience import checkpoint as _ckpt
@@ -496,7 +629,7 @@ class BaseModule(object):
                 batch_end_callback, epoch_end_callback, eval_end_callback,
                 eval_batch_end_callback, fit_k, _queue_metric,
                 _drain_metrics, _after_steps, ckpt_mgr, loop, _capture,
-                resume_skip, resume_metric)
+                resume_skip, resume_metric, auto_tuner)
         finally:
             if fleet_hb is not None:
                 fleet_hb.stop()
@@ -508,15 +641,49 @@ class BaseModule(object):
             if ckpt_mgr is not None:
                 ckpt_mgr.wait()
 
+    def _note_op_costs(self, train_data):
+        """Emit the bound symbol's per-op analytic cost table into the
+        telemetry JSONL once per fit (``type=op_costs``) — perf_doctor
+        joins it with the roofline peak tables to rank memory-bound ops
+        as concrete kernel candidates. Advisory: any failure (symbol-
+        less module, shapeless iterator) is silently skipped."""
+        if not _tm.anatomy.enabled():
+            return
+        try:
+            from ..telemetry import costmodel as _cm
+
+            sym = getattr(self, "symbol", None)
+            if sym is None:
+                return
+            shapes = {}
+            for desc in (list(getattr(train_data, "provide_data", None)
+                              or []) +
+                         list(getattr(train_data, "provide_label", None)
+                              or [])):
+                shapes[desc[0]] = tuple(desc[1])
+            if not shapes:
+                return
+            _tm.anatomy.note_op_costs(
+                _cm.analytic_op_costs(sym, **shapes))
+        except Exception:  # noqa: BLE001 — advisory only
+            pass
+
     def _fit_epochs(self, fit_data, train_data, eval_data, eval_metric,
                     validation_metric, begin_epoch, num_epoch, monitor,
                     batch_end_callback, epoch_end_callback,
                     eval_end_callback, eval_batch_end_callback, fit_k,
                     _queue_metric, _drain_metrics, _after_steps, ckpt_mgr,
-                    loop, _capture, resume_skip, resume_metric):
+                    loop, _capture, resume_skip, resume_metric,
+                    auto_tuner=None):
         """Epoch loop body of :meth:`fit` (split out so the signal-window
         try/finally in fit stays readable)."""
         _tm.anatomy.begin_loop()
+        self._note_op_costs(train_data)
+
+        def _k():
+            # the auto tuner's depth is live (it can grow between
+            # groups); a fixed MXNET_FIT_MULTISTEP=K never changes
+            return auto_tuner.k if auto_tuner is not None else fit_k
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -545,7 +712,7 @@ class BaseModule(object):
                                 nbatch=nbatch, eval_metric=eval_metric,
                                 monitor=monitor)
 
-                if len(pending) == fit_k:
+                if len(pending) == _k():
                     with _tm.span("fit.step_group", epoch=epoch,
                                   k=len(pending)):
                         t0 = time.perf_counter()
@@ -566,6 +733,8 @@ class BaseModule(object):
                     # K updates), so step bookkeeping — and any interval
                     # / preemption checkpoint — lands on its boundary
                     _after_steps(epoch, pending[-1][0] + 1, len(pending))
+                    if auto_tuner is not None:
+                        auto_tuner.after_group(len(pending))
                 else:
                     # partial trailing group: single-step path (already
                     # compiled; a one-off K'-step compile isn't worth it)
@@ -584,7 +753,7 @@ class BaseModule(object):
 
             for nbatch, data_batch in enumerate(fit_data, start=skip):
                 use_multi = (
-                    fit_k > 1 and monitor is None
+                    _k() > 1 and monitor is None
                     and getattr(self, "_fused_trainer", None) is not None
                     and hasattr(self, "update_multi")
                 )
@@ -598,7 +767,7 @@ class BaseModule(object):
                         _flush_group(pending, epoch, eval_metric)
                         pending = []
                     pending.append((nbatch, data_batch))
-                    if len(pending) == fit_k:
+                    if len(pending) == _k():
                         _flush_group(pending, epoch, eval_metric)
                         pending = []
                     continue

@@ -412,6 +412,59 @@ def _conv2d_wgrad_taps(data, weight, stride, pad, dilate):
     return _conv2d_wgrad_custom(data, weight, stride, pad, dilate, wgrad)
 
 
+def _pallas_conv_plan(data, weight, stride, pad, dilate, groups):
+    """Dispatch-table lookup for the Pallas conv-backward pair.
+
+    Cheap env check first; the pallas_kernels import and the per-shape
+    envelope decision (memoized there) only run when
+    MXTPU_CONV_KERNEL=pallas is set. Returns the plan dict or None —
+    None falls through to the taps lever / XLA default below."""
+    if groups != 1:
+        return None
+    try:
+        from . import pallas_kernels as _pk
+    except Exception:  # noqa: BLE001 — pallas unavailable: fall back
+        return None
+    if not _pk.conv_kernel_enabled():
+        return None
+    return _pk.conv_bwd_plan(tuple(data.shape), tuple(weight.shape),
+                             tuple(stride), tuple(pad), tuple(dilate),
+                             data.dtype)
+
+
+def _conv2d_pallas_bwd(data, weight, pad):
+    """Stride-1 2-D conv whose BOTH gradient convs are the Pallas
+    conv-backward pair (ops/pallas_kernels.conv_bwd_input/_filter):
+    im2col-free in-register tap accumulation, f32 accumulators, no
+    lhs-dilated dgrad conv. Forward stays XLA's own lowering (it is
+    already MXU-shaped). Only called for shapes inside the tuned
+    envelope (_pallas_conv_plan); numerics pinned in
+    tests/test_conv_kernels.py."""
+    from . import pallas_kernels as _pk
+
+    def plain(d, w):
+        return jax.lax.conv_general_dilated(
+            d, w, window_strides=(1, 1),
+            padding=[(p, p) for p in pad],
+            dimension_numbers=_conv_dn(2))
+
+    @jax.custom_vjp
+    def conv(d, w):
+        return plain(d, w)
+
+    def fwd(d, w):
+        return plain(d, w), (d, w)
+
+    def bwd(res, g):
+        d, w = res
+        gd = _pk.conv_bwd_input(g, w, d.shape, pad)
+        gw = _pk.conv_bwd_filter(d, g, w.shape, pad)
+        return gd.astype(d.dtype), gw.astype(w.dtype)
+
+    conv.defvjp(fwd, bwd)
+    return conv(data, weight)
+
+
 def _conv2d_s2d_strided(data, weight, kernel, pad, groups):
     """Stride-2 2-D conv computed in 2x2 space-to-depth space — exact,
     and the gradient convs become STRIDE-1 (no lhs-dilated dgrad, which
@@ -509,6 +562,14 @@ def _convolution(attrs, ins, is_train):
             and all(k in (2 * p + 1, 2 * p + 2)
                     for k, p in zip(kernel, pad))):
         out = _conv2d_s2d_strided(data, weight, kernel, pad, groups)
+    elif (nd == 2
+            and _pallas_conv_plan(data, weight, stride, pad, dilate,
+                                  groups) is not None):
+        # MXTPU_CONV_KERNEL=pallas and this shape is inside the tuned
+        # envelope: gradient convs go through the Pallas pair.
+        # Out-of-envelope shapes fall through — to the taps/patches
+        # levers if also set, else XLA's default gradient lowering.
+        out = _conv2d_pallas_bwd(data, weight, pad)
     elif nd == 2 and os.environ.get("MXNET_CONV_BWD_LAYOUT") == "NHWC":
         out = _conv2d_bwd_nhwc(data, weight, stride, pad, dilate, groups)
     elif (nd == 2 and os.environ.get("MXNET_CONV_WGRAD") == "patches"

@@ -246,6 +246,52 @@ def _phase_totals():
 
 
 _state = None  # active interval accumulator (fit-loop thread only)
+_multistep = None  # last MXNET_FIT_MULTISTEP=auto decision (joins records)
+
+
+def note_multistep(k, settled, dispatch_frac=None):
+    """Record the fit loop's current multi-step scan depth (the
+    MXNET_FIT_MULTISTEP=auto tuner's choice) so every subsequent anatomy
+    interval record carries it — the chosen depth is part of the step's
+    anatomy, not a side channel."""
+    global _multistep
+    ms = {"k": int(k), "auto": True, "settled": bool(settled)}
+    if dispatch_frac is not None:
+        ms["dispatch_frac"] = round(float(dispatch_frac), 4)
+    _multistep = ms
+
+
+def emit_decision(record):
+    """Write one freestanding decision record (e.g. type=multistep_auto)
+    to the telemetry JSONL. No-op when anatomy is off; never raises."""
+    if not enabled():
+        return
+    try:
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        _export.emit_record(rec)
+    except Exception as exc:  # noqa: BLE001 — observers must not raise
+        _LOG.debug("emit_decision failed: %s", exc)
+
+
+def note_op_costs(ops, device_kind=None, compute_dtype=None):
+    """Emit the per-op analytic cost table (costmodel.analytic_op_costs)
+    as one ``{"type": "op_costs"}`` JSONL record. perf_doctor joins it
+    with the peak tables to rank memory-bound ops as Pallas-kernel
+    candidates. Best-effort: truncates to 64 ops, never raises."""
+    if not enabled() or not ops:
+        return
+    try:
+        _export.emit_record({
+            "type": "op_costs",
+            "t": time.time(),
+            "device_kind": device_kind or _device_kind(),
+            "compute_dtype": compute_dtype,
+            "n_ops": len(ops),
+            "ops": list(ops)[:64],
+        })
+    except Exception as exc:  # noqa: BLE001 — observers must not raise
+        _LOG.debug("note_op_costs failed: %s", exc)
 
 
 def begin_loop():
@@ -308,6 +354,8 @@ def emit_interval(force=False):
         "unattributed_seconds": wall - sum(phases.values()),
         "recompiles": _C_RECOMPILES.value() - st["recompiles0"],
     }
+    if _multistep is not None:
+        record["multistep"] = dict(_multistep)
     cost = _current_cost
     if cost:
         record["flops_per_step"] = cost["flops"]
@@ -366,10 +414,11 @@ def _device_kind():
 def reset_state():
     """Drop caches, fingerprints, and the active interval (telemetry
     reset path — test isolation)."""
-    global _state, _current_cost
+    global _state, _current_cost, _multistep
     with _lock:
         _cost_cache.clear()
         _last_fp.clear()
         _program_meta.clear()
         _state = None
         _current_cost = None
+        _multistep = None

@@ -225,3 +225,161 @@ def analytic_forward_flops(symbol, **input_shapes):
         if not attrs.get("no_bias", False):
             total += float(n_out)
     return total
+
+
+# Per-op rough cost constants for analytic_op_costs. FLOPs are forward-
+# pass, per output element, for the NON-dense ops (dense ops get the
+# exact MAC count above); bytes are traffic multipliers on the element
+# count (reads + writes at dtype width), assuming no fusion — i.e. the
+# worst case a hand-written kernel would attack. Deliberately coarse:
+# the table exists to RANK kernel candidates, not to predict absolute
+# runtimes.
+_ELTWISE_OPS = ("Activation", "LeakyReLU", "relu", "sigmoid", "tanh",
+                "elemwise_add", "_Plus", "_plus", "broadcast_add",
+                "broadcast_plus", "_add", "add_n", "Dropout", "clip")
+_DENSE_OPS = ("Convolution", "Deconvolution", "FullyConnected")
+
+
+def analytic_op_costs(symbol, dtype_bytes=2, **input_shapes):
+    """Per-op forward {flops, bytes} table for ``symbol`` at the given
+    input shapes — the roofline's view of each node, before fusion.
+
+    Dense ops (conv/FC/deconv) get the exact 2-MAC count that
+    :func:`analytic_forward_flops` totals, plus in+weight+out traffic.
+    Memory-shaped ops (BatchNorm, activations, pooling, eltwise,
+    softmax) get coarse per-element flop counts and unfused read/write
+    traffic at ``dtype_bytes`` per element. Returns a list of
+    ``{"name", "op", "flops", "bytes", "numel_out"}`` dicts in graph
+    order; ops the table does not model are skipped. Feed the result to
+    :func:`rank_kernel_candidates`."""
+    internals = symbol.get_internals()
+    names = internals.list_outputs()
+    _, oshapes, _ = internals.infer_shape(**input_shapes)
+    shape_of = dict(zip(names, oshapes))
+
+    def _in_shape(node, i):
+        inode, iidx = node.inputs[i]
+        return shape_of.get(inode.output_names()[iidx])
+
+    def _numel(shape):
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n
+
+    rows = []
+    for node in symbol._nodes():
+        if node.is_variable:
+            continue
+        op = node.op.name
+        out = shape_of.get(node.output_names()[0])
+        dat = _in_shape(node, 0)
+        if out is None or dat is None:
+            continue
+        attrs = node.canon_attrs()
+        n_out = _numel(out)
+        n_in = _numel(dat)
+        flops = bytes_ = None
+        if op in _DENSE_OPS:
+            from ..ops.utils import as_tuple
+
+            groups = max(int(attrs.get("num_group", 1)), 1)
+            if op == "FullyConnected":
+                in_feat = n_in // max(int(dat[0]), 1)
+                flops = 2.0 * n_out * in_feat
+                w_elems = (n_out // max(int(out[0]), 1)) * in_feat
+            else:
+                kernel = as_tuple(attrs.get("kernel"),
+                                  name="kernel") or (1,)
+                k_elems = 1
+                for d in kernel:
+                    k_elems *= int(d)
+                if op == "Convolution":
+                    flops = 2.0 * n_out * (int(dat[1]) // groups) * k_elems
+                else:
+                    nf = int(attrs.get("num_filter", 1))
+                    flops = 2.0 * n_in * (nf // groups) * k_elems
+                nf = int(attrs.get("num_filter", int(out[1])))
+                w_elems = nf * (int(dat[1]) // groups) * k_elems
+            bytes_ = (n_in + w_elems + n_out) * dtype_bytes
+        elif op == "BatchNorm":
+            # mean/var reduce + normalize + scale/shift ≈ 8 flops/elem;
+            # unfused: read x twice (stats + normalize), write y, plus
+            # f32 stats traffic (folded into the constant)
+            flops = 8.0 * n_out
+            bytes_ = 3.0 * n_out * dtype_bytes
+        elif op == "Pooling":
+            from ..ops.utils import as_tuple
+
+            kernel = as_tuple(attrs.get("kernel"), name="kernel") or (1,)
+            k_elems = 1
+            for d in kernel:
+                k_elems *= int(d)
+            if attrs.get("global_pool", False):
+                k_elems = max(n_in // max(n_out, 1), 1)
+            flops = float(k_elems) * n_out
+            bytes_ = (n_in + n_out) * dtype_bytes
+        elif op in ("SoftmaxOutput", "softmax", "Softmax",
+                    "SoftmaxActivation", "log_softmax"):
+            # max + sub + exp + sum + div ≈ 6 flops/elem
+            flops = 6.0 * n_out
+            bytes_ = 2.0 * n_out * dtype_bytes
+        elif op == "Flatten" or op == "Reshape":
+            continue  # layout-only: XLA elides these
+        elif op in _ELTWISE_OPS or op.startswith(("elemwise_",
+                                                  "broadcast_")):
+            flops = 1.0 * n_out
+            # binary eltwise reads two operands; unary reads one — use
+            # the input count actually wired into the node
+            n_args = max(len(node.inputs), 1)
+            bytes_ = (n_args * n_out + n_out) * dtype_bytes
+        else:
+            continue
+        rows.append({"name": node.name, "op": op,
+                     "flops": float(flops), "bytes": float(bytes_),
+                     "numel_out": int(n_out)})
+    return rows
+
+
+def rank_kernel_candidates(ops, kind=None, dtype=None, peak_flops=None,
+                           peak_bytes=None, top=None):
+    """Rank memory-bound ops as hand-kernel (fusion) candidates.
+
+    For each op row from :func:`analytic_op_costs`, run the same
+    dtype-aware roofline :func:`classify` the anatomy record uses
+    (no wall/comm legs): ops whose memory leg exceeds their compute leg
+    are memory-bound, and ``recoverable_ms = t_memory - t_compute`` is
+    the per-forward-pass time above the compute floor that a fused
+    kernel could reclaim by amortizing the op's traffic into a
+    neighbor — an upper bound, used for ORDERING not prediction.
+    Returns rows sorted by recoverable_ms descending, each extended
+    with ``{"bound", "t_compute_ms", "t_memory_ms", "recoverable_ms",
+    "intensity"}``. Empty when peak rates are unknown."""
+    pf = peak_flops if peak_flops is not None \
+        else peak_flops_for_kind(kind, dtype)
+    pb = peak_bytes if peak_bytes is not None \
+        else peak_bytes_for_kind(kind)
+    if not pf or not pb:
+        return []
+    out = []
+    for op in ops:
+        f = op.get("flops") or 0.0
+        b = op.get("bytes") or 0.0
+        if not b:
+            continue
+        leg = classify(f or None, b, None, None, pf, pb)
+        if leg["bound"] != "memory":
+            continue
+        t_c = leg["t_compute"] or 0.0
+        t_m = leg["t_memory"] or 0.0
+        row = dict(op)
+        row.update({
+            "bound": leg["bound"],
+            "t_compute_ms": t_c * 1e3,
+            "t_memory_ms": t_m * 1e3,
+            "recoverable_ms": (t_m - t_c) * 1e3,
+            "intensity": (f / b) if b else None,
+        })
+        out.append(row)
+    out.sort(key=lambda r: -r["recoverable_ms"])
+    return out[:top] if top else out

@@ -69,8 +69,9 @@ _ADVICE = {
 
 
 def load_records(path):
-    """(anatomy, recompiles, last-metrics) from one telemetry JSONL."""
-    anatomy, recompiles, metrics = [], [], None
+    """(anatomy, recompiles, last-metrics, last-op_costs) from one
+    telemetry JSONL."""
+    anatomy, recompiles, metrics, op_costs = [], [], None, None
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -87,7 +88,9 @@ def load_records(path):
                 recompiles.append(rec)
             elif t == "metrics":
                 metrics = rec.get("metrics")
-    return anatomy, recompiles, metrics
+            elif t == "op_costs":
+                op_costs = rec
+    return anatomy, recompiles, metrics, op_costs
 
 
 def steady_intervals(records, keep_all=False):
@@ -215,6 +218,45 @@ def _step_latency_percentiles(metrics):
                                         agg_sum, q) for q in (50, 99))
 
 
+def kernel_candidates_section(op_costs, anatomy):
+    """Roofline-ranked "write a kernel here next" table.
+
+    Joins the fit loop's ``type=op_costs`` record (per-op analytic
+    flops/bytes from ``costmodel.analytic_op_costs``) with the peak-rate
+    tables via ``costmodel.rank_kernel_candidates``: memory-bound ops
+    sorted by the per-forward-pass milliseconds a fused kernel could
+    recover. Returns the formatted section, or None when there is no
+    op_costs record or the device's peaks are unknown."""
+    if not op_costs or not op_costs.get("ops"):
+        return None
+    from mxnet_tpu.telemetry import costmodel
+
+    kind = op_costs.get("device_kind")
+    dtype = op_costs.get("compute_dtype")
+    if (not kind or not dtype) and anatomy:
+        last = anatomy[-1]
+        kind = kind or last.get("device_kind")
+        dtype = dtype or last.get("compute_dtype")
+    ranked = costmodel.rank_kernel_candidates(
+        op_costs["ops"], kind=kind, dtype=dtype, top=8)
+    if not ranked:
+        return None
+    out = ["== kernel candidates (memory-bound ops, roofline-ranked) =="]
+    out.append("  %-28s %-14s %10s %10s %8s %12s" % (
+        "op", "type", "flops", "bytes", "flop/B", "recover ms"))
+    for r in ranked:
+        out.append("  %-28s %-14s %10.3g %10.3g %8.2f %12.4f" % (
+            r.get("name", "?"), r.get("op", "?"),
+            r.get("flops", 0.0), r.get("bytes", 0.0),
+            r.get("intensity") or 0.0, r["recoverable_ms"]))
+    out.append(
+        "  (per forward pass at %s peaks; recover ms = t_memory - "
+        "t_compute, the ceiling a fused Pallas kernel could reclaim — "
+        "see MXTPU_CONV_KERNEL for the conv-backward pair already "
+        "landed)" % (kind or "device"))
+    return "\n".join(out)
+
+
 def fleet_section(run_dir):
     """The cross-rank block of the report, fed from the fleet
     aggregator (never re-parsed here): slowest-rank ranking, skew
@@ -281,7 +323,7 @@ def report(path, keep_all=False):
             fleet_text, _ = fleet_section(run_dir)
         except Exception:  # noqa: BLE001
             fleet_text = None
-    anatomy, recompiles, metrics = load_records(path)
+    anatomy, recompiles, metrics, op_costs = load_records(path)
     out = ["== step anatomy ==", format_anatomy(anatomy)]
     if fleet_text:
         out = [fleet_text, ""] + out
@@ -319,9 +361,25 @@ def report(path, keep_all=False):
         diag += "; device model says the interval is %s-bound" % roof
     out += ["", diag]
 
+    ms = next((r["multistep"] for r in reversed(anatomy)
+               if r.get("multistep")), None)
+    if ms:
+        out.append(
+            "multistep: K=%d%s%s" % (
+                ms.get("k", 0),
+                " (auto, settled)" if ms.get("settled")
+                else " (auto, still growing)" if ms.get("auto") else "",
+                "" if ms.get("dispatch_frac") is None else
+                ", dispatch at %.1f%% of device time"
+                % (100.0 * ms["dispatch_frac"])))
+
     amp = amp_advice(anatomy)
     if amp:
         out.append(amp)
+
+    kc = kernel_candidates_section(op_costs, anatomy)
+    if kc:
+        out += ["", kc]
 
     pcts = _step_latency_percentiles(metrics)
     if pcts:
@@ -363,9 +421,22 @@ def _self_test():
         f.write(json.dumps(anatomy_rec(0, dict(base), 2.0)) + "\n")
         f.write(json.dumps(anatomy_rec(1, dict(base), 0.01,
                                        mfu=0.12)) + "\n")
-        f.write(json.dumps(anatomy_rec(2, dict(base), 0.01, mfu=0.14,
-                                       bound="compute", dtype="f32",
-                                       kind="TPU v5e")) + "\n")
+        rec2 = anatomy_rec(2, dict(base), 0.01, mfu=0.14,
+                           bound="compute", dtype="f32", kind="TPU v5e")
+        rec2["multistep"] = {"k": 8, "auto": True, "settled": True,
+                             "dispatch_frac": 0.031}
+        f.write(json.dumps(rec2) + "\n")
+        # op_costs record: one clearly memory-bound op (bn) and one
+        # clearly compute-bound (conv) — only bn may surface as a
+        # kernel candidate
+        f.write(json.dumps({
+            "type": "op_costs", "device_kind": "TPU v5e",
+            "compute_dtype": "bf16", "n_ops": 2, "ops": [
+                {"name": "stage1_bn1", "op": "BatchNorm",
+                 "flops": 1e6, "bytes": 1e9, "numel_out": 100},
+                {"name": "stage1_conv1", "op": "Convolution",
+                 "flops": 1e13, "bytes": 1e6, "numel_out": 100},
+            ]}) + "\n")
         for shape in ([16, 8], [12, 8]):
             f.write(json.dumps({
                 "type": "recompile", "program": 0,
@@ -381,8 +452,19 @@ def _self_test():
                             0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                             30.0]}]}}}) + "\n")
 
-    anatomy, recompiles, metrics = load_records(path)
+    anatomy, recompiles, metrics, op_costs = load_records(path)
     assert len(anatomy) == 3 and len(recompiles) == 2, (anatomy, recompiles)
+    assert op_costs and op_costs["n_ops"] == 2, op_costs
+
+    # kernel candidates: the memory-bound bn surfaces, the
+    # compute-bound conv does not
+    kc = kernel_candidates_section(op_costs, anatomy)
+    assert kc and "stage1_bn1" in kc, kc
+    assert "stage1_conv1" not in kc, kc
+    # unknown device kind -> no peaks -> section degrades to None
+    assert kernel_candidates_section(
+        {"ops": op_costs["ops"], "device_kind": "mystery-chip",
+         "compute_dtype": "bf16"}, []) is None
 
     # steady diagnosis must drop the warmup interval and rank
     # device_sync (12 ms/step) first; with it kept, the warmup
@@ -418,6 +500,8 @@ def _self_test():
     assert "2x data.shape" in text, text
     assert "MFU trajectory" in text and "step anatomy" in text, text
     assert "p50=" in text and "p99=" in text, text
+    assert "kernel candidates" in text and "stage1_bn1" in text, text
+    assert "multistep: K=8 (auto, settled)" in text, text
 
     # empty / anatomy-free file degrades to a message, not a crash
     empty = os.path.join(d, "empty.jsonl")

@@ -243,6 +243,26 @@ def main():
         out["conv"] = run_conv_score(jax, jnp, smoke=SMOKE or not on_tpu)
         print(json.dumps({"conv_rows": len(out["conv"]["rows"])}),
               file=sys.stderr)
+    if os.environ.get("SCORE_INPUT", "0") == "1":
+        # ISSUE 18 rider: host input-pipeline A/B — thread-pool decode
+        # (preprocess_threads) vs the streaming process pool
+        # (MXTPU_INPUT_WORKERS), one input_img_s row per setting, with
+        # the io.decode_seconds / io.queue_depth / io.bytes_read
+        # backpressure telemetry in the same BENCH artifact. The
+        # acceptance gate reads process_vs_thread_speedup (>= 2x at
+        # workers=4 on an 8-core host).
+        from benchmarks.input_pipeline import run_input_bench
+
+        out["input_pipeline"] = run_input_bench(
+            n_images=32 if SMOKE else 256,
+            image_size=64 if SMOKE else 224,
+            threads=(1, 4) if SMOKE else (1, 4, 8),
+            workers=(2,) if SMOKE else (2, 4),
+            epochs=1 if SMOKE else 2)
+        print(json.dumps({"input_pipeline": out["input_pipeline"]["rows"],
+                          "speedup": out["input_pipeline"].get(
+                              "process_vs_thread_speedup")}),
+              file=sys.stderr)
     run_dir = os.environ.get("MXTPU_RUN_DIR")
     if run_dir and glob.glob(os.path.join(run_dir, "telemetry_r*.jsonl")):
         # ISSUE 16 rider: fleet skew next to MFU — when the bench ran

@@ -42,6 +42,7 @@ from . import optimizer
 from . import lr_scheduler
 from . import metric
 from . import io
+from . import io_pipeline
 from . import recordio
 from . import kvstore as kvs
 from .kvstore import create as _kv_create

@@ -371,23 +371,48 @@ class ImageIter(DataIter):
                              mean_r=0.0, mean_g=0.0, mean_b=0.0, scale=1.0,
                              rand_crop=False, rand_mirror=False, shuffle=False,
                              preprocess_threads=4, path_imgidx=None,
-                             label_width=1, **kwargs):
+                             label_width=1, input_workers=None, seed=0,
+                             shuffle_buffer=None, strict_order=None,
+                             **kwargs):
         """Adapter giving the C++ ImageRecordIter's param names
-        (iter_image_recordio_2.cc param struct)."""
+        (iter_image_recordio_2.cc param struct).
+
+        When ``input_workers`` (or ``MXTPU_INPUT_WORKERS``) is > 0 this
+        returns the chunk-sharded, process-parallel
+        :class:`io_pipeline.StreamingImageRecordIter` instead of the
+        thread-pool ImageIter — the augment params here are all
+        declarative, so they survive the process boundary as a recipe.
+        """
+        from . import io_pipeline
+
         mean = None
         if mean_r or mean_g or mean_b:
             mean = np.array([mean_r, mean_g, mean_b])
-        aug = CreateAugmenter(
-            data_shape, rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean
-        )
-        if scale != 1.0:
-            aug.append(lambda src: [src * scale])
         if path_imgidx is None and path_imgrec.endswith(".rec"):
             # im2rec always writes the sibling .idx; pick it up so
             # shuffle/partition work without the extra param
             candidate = path_imgrec[:-4] + ".idx"
             if os.path.exists(candidate):
                 path_imgidx = candidate
+        if input_workers is None:
+            input_workers = io_pipeline.input_workers()
+        if input_workers > 0:
+            recipe = {"rand_crop": rand_crop, "rand_mirror": rand_mirror,
+                      "scale": scale}
+            if mean is not None:
+                recipe["mean"] = mean
+            return io_pipeline.StreamingImageRecordIter(
+                batch_size, tuple(data_shape), path_imgrec,
+                path_imgidx=path_imgidx, label_width=label_width,
+                shuffle=shuffle, seed=seed, aug_recipe=recipe,
+                workers=input_workers, shuffle_buffer=shuffle_buffer,
+                strict_order=strict_order,
+            )
+        aug = CreateAugmenter(
+            data_shape, rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean
+        )
+        if scale != 1.0:
+            aug.append(lambda src: [src * scale])
         return cls(
             batch_size, tuple(data_shape), label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,

@@ -633,7 +633,11 @@ def ImageRecordIter(**kwargs):
     Implemented over mx.image.ImageIter + PrefetchingIter; accepts the
     reference's main params (path_imgrec, data_shape, batch_size,
     mean_r/g/b, scale, rand_crop, rand_mirror, shuffle,
-    preprocess_threads)."""
+    preprocess_threads). With ``input_workers`` > 0 (or
+    ``MXTPU_INPUT_WORKERS``) the streaming pipeline takes over:
+    chunk-sharded reads by (host_rank, num_hosts), a spawn-safe process
+    decode pool, and the ``MXTPU_SHUFFLE_BUFFER`` cross-chunk shuffle —
+    see ``io_pipeline.StreamingImageRecordIter``."""
     from .image import ImageIter
 
     return ImageIter.from_recordio_params(**kwargs)

@@ -1,0 +1,802 @@
+"""Parallel streaming input pipeline.
+
+The production input layer ROADMAP item 4 calls for: chunked RecordIO
+reads sharded by ``(host_rank, num_hosts)`` so every host reads
+disjoint data, a spawn-safe multi-**process** decode pool
+(``MXTPU_INPUT_WORKERS``) that moves CPU-heavy JPEG decode + augment
+off the GIL-bound thread pool, an overlap-aware shuffle buffer
+(``MXTPU_SHUFFLE_BUFFER``) that randomizes across chunk boundaries
+without a barrier, and an O(1) cursor expressed as the sample position
+so ``skip()``, SIGKILL resume, and dp-reshape reposition the sharded
+pipeline exactly (the same global-sample-position invariant the
+elastic resume math in ``module/base_module.py`` translates through).
+
+This is the spirit of dmlc-core's ThreadedIter + the reference's OMP
+decode team (``iter_image_recordio_2.cc:103-119``) rebuilt for a
+python host: threads cannot scale JPEG decode past the GIL, so the
+workers are spawned processes that each read their own byte ranges
+(the bounded task/result queues carry chunk descriptors down and
+decoded numpy batch slabs back — backpressure in both directions).
+
+Ordering contract
+-----------------
+With ``strict_order`` on (the default, ``MXTPU_INPUT_STRICT_ORDER``),
+batch contents are a pure function of (seed, shard, shuffle buffer
+size) — independent of worker count and completion timing: samples are
+assembled by global record ordinal from a deterministic schedule, and
+every sample's augmentation RNG is seeded from its ordinal. With it
+off, chunks are consumed in completion order (lowest latency, no
+resequencing stalls) and determinism is not guaranteed.
+
+Feed ``StreamingImageRecordIter`` straight into
+``io.DeviceFeedIter`` — decode runs in the worker pool, the transfer
+overlaps compute, and input work stops appearing in
+``io.feed_wait_seconds`` (the backpressure lives in ``io.queue_depth``
+/ ``io.decode_seconds`` instead).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import random as _pyrandom
+import re
+import time
+import weakref
+from collections import deque
+
+import numpy as np
+
+from . import recordio
+from . import telemetry as _tm
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+
+ENV_WORKERS = "MXTPU_INPUT_WORKERS"
+ENV_SHUFFLE_BUFFER = "MXTPU_SHUFFLE_BUFFER"
+ENV_CHUNK_BYTES = "MXTPU_INPUT_CHUNK_BYTES"
+ENV_STRICT_ORDER = "MXTPU_INPUT_STRICT_ORDER"
+
+_H_DECODE = _tm.histogram(
+    "io.decode_seconds",
+    "Per-chunk decode+augment wall time inside input workers (labelled "
+    "by worker mode) — compare against io.feed_wait_seconds: decode "
+    "belongs here, never in the feed path")
+_G_QDEPTH = _tm.gauge(
+    "io.queue_depth",
+    "Streaming input pipeline backpressure: chunk tasks in flight "
+    "(queue=\"tasks\") and decoded-but-unconsumed chunks "
+    "(queue=\"ready\")")
+_C_BYTES = _tm.counter(
+    "io.bytes_read",
+    "Raw .rec bytes pulled through the streaming input pipeline")
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def input_workers(default=0):
+    """``MXTPU_INPUT_WORKERS``: decode processes. 0 keeps the classic
+    in-process thread-pool path."""
+    return max(0, _env_int(ENV_WORKERS, default))
+
+
+def shuffle_buffer_size(default=0):
+    """``MXTPU_SHUFFLE_BUFFER``: samples held by the streaming shuffle
+    buffer (<=1 disables cross-chunk mixing)."""
+    return max(0, _env_int(ENV_SHUFFLE_BUFFER, default))
+
+
+def chunk_bytes(default=4 << 20):
+    """``MXTPU_INPUT_CHUNK_BYTES``: target chunk size for the
+    record-aligned byte-range splits."""
+    return max(1, _env_int(ENV_CHUNK_BYTES, default))
+
+
+def strict_order(default=True):
+    """``MXTPU_INPUT_STRICT_ORDER``: resequence completed chunks so
+    batches are worker-count-independent (default on)."""
+    raw = os.environ.get(ENV_STRICT_ORDER)
+    if raw is None or raw == "":
+        return bool(default)
+    return raw not in ("0", "false", "no")
+
+
+# ---------------------------------------------------------------------------
+# Worker side (runs in spawned child processes — keep picklable/top-level)
+
+#: CreateAugmenter kwargs a declarative recipe may carry (closures cannot
+#: cross a process boundary; workers rebuild the chain from this).
+AUG_RECIPE_KEYS = (
+    "resize", "rand_crop", "rand_resize", "rand_mirror", "mean", "std",
+    "brightness", "contrast", "saturation", "pca_noise", "inter_method",
+)
+
+
+def _mix_seed(seed, ordinal):
+    """Stable 32-bit per-sample seed from (pipeline seed, global record
+    ordinal) — splitmix64-style so neighboring ordinals decorrelate."""
+    x = (int(seed) * 0x9E3779B97F4A7C15 + (int(ordinal) + 1)
+         * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 27
+    return x & 0x7FFFFFFF
+
+
+def _build_augmenters(data_shape, recipe):
+    from .image import CreateAugmenter
+
+    recipe = dict(recipe or {})
+    scale = recipe.pop("scale", 1.0)
+    aug = CreateAugmenter(
+        data_shape,
+        **{k: v for k, v in recipe.items() if k in AUG_RECIPE_KEYS})
+    if scale != 1.0:
+        aug.append(lambda src: [src * scale])
+    return aug
+
+
+def _decode_chunk_payloads(payloads, ordinal0, cfg, auglist):
+    """Decode+augment one chunk's record payloads into contiguous batch
+    slabs: ``(data[n,h,w,c] f32, label[n(,label_width)] f32, valid[n])``.
+
+    Per-sample determinism: when ``cfg['seed']`` is set, the global RNGs
+    are seeded from the record's global ordinal before its augment chain
+    runs (and restored afterwards), so the draw sequence depends only on
+    WHICH sample is augmented — never on which worker got it or how the
+    chunk was batched."""
+    c, h, w = cfg["data_shape"]
+    lw = int(cfg.get("label_width", 1))
+    n = len(payloads)
+    data = np.zeros((n, h, w, c), np.float32)
+    label = np.zeros((n,) if lw == 1 else (n, lw), np.float32)
+    valid = np.zeros((n,), np.bool_)
+    seed = cfg.get("seed")
+    saved = None
+    if seed is not None:
+        saved = (_pyrandom.getstate(), np.random.get_state())
+    try:
+        for j, s in enumerate(payloads):
+            try:
+                header, img = recordio.unpack(s)
+                if seed is not None:
+                    sj = _mix_seed(seed, ordinal0 + j)
+                    _pyrandom.seed(sj)
+                    np.random.seed(sj & 0xFFFFFFFF)
+                arr = recordio._imdecode_np(bytes(img), 1)
+                if arr is None or arr.size == 0:
+                    continue
+                arr = np.asarray(arr, np.float32)
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
+                outs = [arr]
+                for aug in auglist:
+                    outs = [r for src in outs for r in aug(src)]
+                # streaming slabs are strictly 1:1 — fan-out augmenters
+                # belong to the classic ImageIter path
+                d = outs[0]
+                data[j] = np.asarray(
+                    d.asnumpy() if hasattr(d, "asnumpy") else d,
+                    np.float32)
+                lab = np.ravel(np.asarray(header.label, np.float32))
+                if lw == 1:
+                    label[j] = lab[0] if lab.size else 0.0
+                else:
+                    label[j, :min(lw, lab.size)] = lab[:lw]
+                valid[j] = True
+            except (MXNetError, OSError, ValueError):
+                continue  # undecodable image: the assembler pulls a
+                # replacement from the schedule
+    finally:
+        if saved is not None:
+            _pyrandom.setstate(saved[0])
+            np.random.set_state(saved[1])
+    return data, label, valid
+
+
+def _worker_main(task_q, result_q, cfg):
+    """Decode-worker loop (spawned child). Tasks are chunk descriptors
+    ``(seq, start, end, ordinal, n_records)``; the worker reads its own
+    byte range (disjoint from every other worker's), decodes, and ships
+    slabs back. ``None`` is the shutdown sentinel."""
+    auglist = _build_augmenters(cfg["data_shape"], cfg.get("recipe"))
+    handle = open(cfg["uri"], "rb")
+    while True:
+        task = task_q.get()
+        if task is None:
+            break
+        seq, start, end, ordinal, n_records = task
+        t0 = time.perf_counter()
+        try:
+            payloads = recordio.read_chunk(
+                handle, recordio.RecordChunk(start, end, ordinal,
+                                             n_records),
+                uri=cfg["uri"])
+            data, label, valid = _decode_chunk_payloads(
+                payloads, ordinal, cfg, auglist)
+            result_q.put((seq, data, label, valid, end - start,
+                          time.perf_counter() - t0, None))
+        except BaseException as e:  # noqa: BLE001 — surfaced in parent
+            result_q.put((seq, None, None, None, 0,
+                          time.perf_counter() - t0,
+                          "%s: %s" % (type(e).__name__, e)))
+
+
+def _child_env():
+    """Env overrides for decode children: a worker must never claim the
+    TPU (or replicate the parent's virtual CPU-mesh device count) just
+    to run libjpeg — force a 1-device CPU jax backend."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    one = "--xla_force_host_platform_device_count=1"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", one, flags)
+    else:
+        flags = (flags + " " + one).strip()
+    return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags,
+            # a worker is pure input machinery: its own telemetry
+            # registry would shadow the parent's
+            "MXTPU_TELEMETRY": "0", "MXTPU_TELEMETRY_FILE": ""}
+
+
+_LIVE_POOLS = weakref.WeakSet()
+
+
+def shutdown_all():
+    """Reap every live decode pool (test teardown / atexit safety net —
+    spawn children are daemonic, but an explicit terminate beats
+    relying on interpreter teardown ordering)."""
+    for pool in list(_LIVE_POOLS):
+        pool.close()
+
+
+atexit.register(shutdown_all)
+
+
+class DecodePool:
+    """Spawn-safe process pool moving chunk decode off the GIL.
+
+    Bounded queues in both directions: task puts block when workers
+    fall behind (the parent stops reading ahead), result puts block
+    when the consumer falls behind (workers stop decoding) — the
+    ThreadedIter producer/consumer contract, across processes.
+    """
+
+    def __init__(self, workers, cfg, capacity=None):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        self.capacity = int(capacity or max(2 * workers, 4))
+        self._tasks = ctx.Queue(self.capacity)
+        self._results = ctx.Queue(self.capacity)
+        self.inflight = 0
+        self._procs = []
+        saved = {}
+        try:
+            for k, v in _child_env().items():
+                saved[k] = os.environ.get(k)
+                os.environ[k] = v
+            for _ in range(int(workers)):
+                p = ctx.Process(target=_worker_main,
+                                args=(self._tasks, self._results, cfg),
+                                daemon=True)
+                p.start()
+                self._procs.append(p)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        _LIVE_POOLS.add(self)
+
+    def submit(self, task):
+        self._tasks.put(task)
+        self.inflight += 1
+
+    def get(self, timeout=300.0):
+        """One result tuple, surfacing worker-side failures. The
+        timeout is a deadlock guard, not a latency bound: it only
+        expires when every worker died without answering."""
+        import queue as _q
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                out = self._results.get(timeout=1.0)
+                self.inflight -= 1
+                return out
+            except _q.Empty:
+                if not any(p.is_alive() for p in self._procs):
+                    raise MXNetError(
+                        "input pipeline: all decode workers exited with "
+                        "%d chunk(s) outstanding" % self.inflight)
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        "input pipeline: no decode result within %.0fs "
+                        "(%d in flight)" % (timeout, self.inflight))
+
+    def close(self):
+        procs, self._procs = self._procs, []
+        if not procs:
+            return
+        for _ in procs:
+            try:
+                self._tasks.put_nowait(None)
+            except Exception:  # noqa: BLE001 — full queue: terminate below
+                break
+        for p in procs:
+            p.join(timeout=2.0)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2.0)
+        for q in (self._tasks, self._results):
+            q.cancel_join_thread()
+            q.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Parent side
+
+
+class StreamingImageRecordIter(DataIter):
+    """Chunk-sharded, process-parallel RecordIO image iterator.
+
+    Sample schedule (strict mode): the epoch's chunk order (seeded
+    shuffle when ``shuffle``), each chunk's records in file order, run
+    through a streaming shuffle buffer of ``shuffle_buffer`` samples —
+    all in *index space*, so repositioning by sample count replays
+    pure integer state without touching bytes or decoders (the O(1)
+    cursor: no decode, no IO, just the schedule RNG).
+
+    ``workers=0`` decodes chunks inline (same schedule, same per-ordinal
+    augment seeding) — the determinism baseline the parity tests compare
+    the pool against.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec,
+                 path_imgidx=None, label_width=1, shuffle=False, seed=0,
+                 aug_recipe=None, workers=None, shuffle_buffer=None,
+                 strict_order=None, chunk_bytes=None, host_rank=None,
+                 num_hosts=None, data_name="data",
+                 label_name="softmax_label"):
+        super().__init__()
+        from .parallel import mesh as _mesh
+
+        if workers is None:
+            workers = input_workers()
+        if shuffle_buffer is None:
+            shuffle_buffer = shuffle_buffer_size()
+        if strict_order is None:
+            strict_order = globals()["strict_order"]()
+        if chunk_bytes is None:
+            chunk_bytes = globals()["chunk_bytes"]()
+        if num_hosts is None:
+            num_hosts = _mesh.host_count()
+        if host_rank is None:
+            host_rank = _mesh.host_rank()
+        if not (0 <= host_rank < num_hosts):
+            raise MXNetError(
+                "host_rank %d outside [0, %d)" % (host_rank, num_hosts))
+        self.batch_size = int(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = int(label_width)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.workers = int(workers)
+        self.shuffle_buffer = int(shuffle_buffer)
+        self.strict = bool(strict_order)
+        self.host_rank = int(host_rank)
+        self.num_hosts = int(num_hosts)
+        self.uri = path_imgrec
+        if path_imgidx is None and path_imgrec.endswith(".rec"):
+            cand = path_imgrec[:-4] + ".idx"
+            if os.path.exists(cand):
+                path_imgidx = cand
+        # the host's shard: every num_hosts-th chunk — fixed for the
+        # whole run so hosts always read disjoint byte ranges; only the
+        # ORDER within the shard reshuffles per epoch
+        all_chunks = recordio.build_chunks(
+            path_imgrec, path_imgidx, chunk_bytes)
+        while (len(all_chunks) < 2 * num_hosts and chunk_bytes > 1
+               and all_chunks
+               and any(c.n_records > 1 for c in all_chunks)):
+            # small file vs. big chunks would starve trailing hosts —
+            # halve until every host owns data (record granularity floor)
+            chunk_bytes = max(1, chunk_bytes // 2)
+            all_chunks = recordio.build_chunks(
+                path_imgrec, path_imgidx, chunk_bytes)
+        self._chunks = all_chunks[host_rank::num_hosts]
+        self.num_samples = sum(c.n_records for c in self._chunks)
+        c, h, w = self.data_shape
+        self.provide_data = [DataDesc(data_name,
+                                      (self.batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(
+            label_name,
+            (self.batch_size,) if self.label_width == 1
+            else (self.batch_size, self.label_width))]
+        self._cfg = {
+            "uri": path_imgrec,
+            "data_shape": self.data_shape,
+            "label_width": self.label_width,
+            "recipe": dict(aug_recipe or {}),
+            "seed": self.seed,
+        }
+        self._auglist = None  # lazy, for inline decode
+        self._pool = None
+        self._epoch = 0
+        self._closed = False
+        self._start_epoch()
+
+    # -- epoch schedule ------------------------------------------------
+
+    def _epoch_rng(self):
+        return np.random.RandomState(
+            _mix_seed(self.seed, 0x5EED0000 + self._epoch))
+
+    def _schedule_gen(self):
+        """Yield ``(chunk_index, record_offset_in_chunk)`` in emission
+        order for this epoch: chunk-order shuffle, then the streaming
+        buffer mixing across chunk boundaries — no barrier, ever: one
+        sample leaves for every sample that enters once the buffer is
+        warm, and the tail drains randomly."""
+        rng = self._epoch_rng()
+        order = list(range(len(self._chunks)))
+        if self.shuffle:
+            rng.shuffle(order)
+        self._chunk_order = order
+
+        def stream():
+            for ci in order:
+                for j in range(self._chunks[ci].n_records):
+                    yield (ci, j)
+
+        size = self.shuffle_buffer if self.shuffle else 0
+        if size <= 1:
+            return stream()
+
+        def mixed():
+            buf = []
+            for item in stream():
+                if len(buf) < size:
+                    buf.append(item)
+                    continue
+                k = int(rng.randint(len(buf)))
+                yield buf[k]
+                buf[k] = item
+            while buf:
+                k = int(rng.randint(len(buf)))
+                buf[k], buf[-1] = buf[-1], buf[k]
+                yield buf.pop()
+
+        return mixed()
+
+    def _start_epoch(self):
+        self._sched = self._schedule_gen()
+        self._sched_buf = deque()
+        self._remaining = {ci: c.n_records
+                           for ci, c in enumerate(self._chunks)}
+        self._cache = {}        # chunk index -> (data, label, valid)
+        self._seq_meta = {}     # seq -> (epoch, chunk index)
+        self._dispatched = set()
+        self._dispatch_order = deque()  # chunk indices, first-need order
+        self._cursor = 0        # schedule entries consumed this epoch
+        # relaxed mode: per-epoch arrival state
+        self._rx_rows = deque()
+        self._rx_rng = self._epoch_rng()
+        self._rx_next_chunk = 0
+        self._seq = getattr(self, "_seq", 0)
+
+    # -- pool / dispatch ----------------------------------------------
+
+    def _ensure_pool(self):
+        if self.workers > 0 and self._pool is None:
+            self._pool = DecodePool(self.workers, self._cfg)
+        return self._pool
+
+    def _refill_lookahead(self):
+        """Pull schedule entries into the lookahead buffer and extend
+        the first-need dispatch order. The window covers one batch plus
+        the pool's pipeline depth so workers always have chunks queued
+        ahead of the assembler."""
+        pool_depth = max(2 * self.workers, 2)
+        want = self.batch_size + pool_depth * max(
+            1, self._chunks[0].n_records if self._chunks else 1)
+        while len(self._sched_buf) < want:
+            try:
+                entry = next(self._sched)
+            except StopIteration:
+                break
+            self._sched_buf.append(entry)
+            ci = entry[0]
+            if (ci not in self._dispatched and ci not in self._cache):
+                self._dispatched.add(ci)
+                self._dispatch_order.append(ci)
+
+    def _pump(self):
+        """Keep the task queue primed (strict mode): submit chunks in
+        first-need order while the pool has capacity."""
+        pool = self._ensure_pool()
+        if pool is None:
+            return
+        while self._dispatch_order and pool.inflight < pool.capacity:
+            ci = self._dispatch_order.popleft()
+            if self._remaining.get(ci, 0) <= 0:
+                continue
+            ch = self._chunks[ci]
+            self._seq_meta[self._seq] = (self._epoch, ci)
+            pool.submit((self._seq, ch.start, ch.end, ch.ordinal,
+                         ch.n_records))
+            self._seq += 1
+        _G_QDEPTH.set(pool.inflight, queue="tasks")
+
+    def _accept(self, seq, data, label, valid, nbytes, secs, err):
+        """Fold one pool result into the cache (dropping stale epochs
+        and already-skipped chunks)."""
+        if err is not None:
+            raise MXNetError("input pipeline worker failed: %s" % err)
+        epoch, ci = self._seq_meta.pop(seq, (None, None))
+        _H_DECODE.observe(secs, mode="process")
+        _C_BYTES.inc(nbytes)
+        if epoch != self._epoch or self._remaining.get(ci, 0) <= 0:
+            return None  # superseded by reset()/skip()
+        self._cache[ci] = (data, label, valid)
+        _G_QDEPTH.set(len(self._cache), queue="ready")
+        return ci
+
+    def _decode_inline(self, ci):
+        if self._auglist is None:
+            self._auglist = _build_augmenters(
+                self.data_shape, self._cfg.get("recipe"))
+        ch = self._chunks[ci]
+        t0 = time.perf_counter()
+        if getattr(self, "_handle", None) is None:
+            self._handle = open(self.uri, "rb")
+        payloads = recordio.read_chunk(self._handle, ch, uri=self.uri)
+        out = _decode_chunk_payloads(
+            payloads, ch.ordinal, self._cfg, self._auglist)
+        _H_DECODE.observe(time.perf_counter() - t0, mode="inline")
+        _C_BYTES.inc(ch.end - ch.start)
+        return out
+
+    def _get_chunk(self, ci):
+        """The chunk's decoded slabs — from cache, the pool (blocking on
+        results until this chunk lands; strict mode tolerates
+        out-of-order completion by caching early arrivals), or inline
+        decode when there is no pool."""
+        while ci not in self._cache:
+            pool = self._ensure_pool()
+            if pool is None or ci not in self._dispatched:
+                self._cache[ci] = self._decode_inline(ci)
+                break
+            self._accept(*pool.get())
+            self._pump()
+        return self._cache[ci]
+
+    def _consume_entry(self, ci):
+        self._remaining[ci] -= 1
+        self._cursor += 1
+        if self._remaining[ci] <= 0 and self._cache.pop(ci, None) is not None:
+            _G_QDEPTH.set(len(self._cache), queue="ready")
+
+    # -- iteration -----------------------------------------------------
+
+    def next(self):
+        if self._closed:
+            raise StopIteration
+        return (self._next_strict() if self.strict
+                else self._next_relaxed())
+
+    def _next_strict(self):
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, h, w, c), np.float32)
+        label = np.zeros(
+            (self.batch_size,) if self.label_width == 1
+            else (self.batch_size, self.label_width), np.float32)
+        rows = 0
+        while rows < self.batch_size:
+            if not self._sched_buf:
+                self._refill_lookahead()
+                if not self._sched_buf:
+                    break
+            self._pump()
+            ci, j = self._sched_buf.popleft()
+            cdata, clabel, cvalid = self._get_chunk(ci)
+            self._consume_entry(ci)
+            if not cvalid[j]:
+                continue
+            data[rows] = cdata[j]
+            label[rows] = clabel[j]
+            rows += 1
+        if rows == 0:
+            raise StopIteration
+        return self._emit(data, label, rows)
+
+    def _next_relaxed(self):
+        """Completion-order assembly: decoded chunks are consumed as
+        they arrive, their samples pooled through the shuffle buffer —
+        a straggler chunk never stalls the feed."""
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, h, w, c), np.float32)
+        label = np.zeros(
+            (self.batch_size,) if self.label_width == 1
+            else (self.batch_size, self.label_width), np.float32)
+        order = getattr(self, "_chunk_order", None)
+        if order is None or self._rx_next_chunk == 0:
+            # materialize this epoch's chunk order without the strict
+            # scheduler (chunk-level only; samples mix in _rx_rows)
+            rng = self._epoch_rng()
+            order = list(range(len(self._chunks)))
+            if self.shuffle:
+                rng.shuffle(order)
+            self._chunk_order = order
+        pool = self._ensure_pool()
+        target = max(self.shuffle_buffer, 1)
+        rows = 0
+        while rows < self.batch_size:
+            # prime the pool with upcoming chunks
+            while (pool is not None
+                   and self._rx_next_chunk < len(order)
+                   and pool.inflight < pool.capacity):
+                ci = order[self._rx_next_chunk]
+                self._rx_next_chunk += 1
+                ch = self._chunks[ci]
+                self._seq_meta[self._seq] = (self._epoch, ci)
+                pool.submit((self._seq, ch.start, ch.end, ch.ordinal,
+                             ch.n_records))
+                self._seq += 1
+            if pool is not None:
+                _G_QDEPTH.set(pool.inflight, queue="tasks")
+            # refill the sample buffer to the shuffle window
+            while len(self._rx_rows) < target:
+                got = None
+                if pool is not None and pool.inflight > 0:
+                    got = self._accept(*pool.get())
+                elif self._rx_next_chunk < len(order):
+                    ci = order[self._rx_next_chunk]
+                    self._rx_next_chunk += 1
+                    self._cache[ci] = self._decode_inline(ci)
+                    got = ci
+                if got is None and (pool is None
+                                    or pool.inflight == 0) \
+                        and self._rx_next_chunk >= len(order):
+                    break
+                if got is not None:
+                    cdata, clabel, cvalid = self._cache.pop(got, (None,) * 3)
+                    if cdata is None:
+                        continue
+                    for j in range(len(cvalid)):
+                        if cvalid[j]:
+                            self._rx_rows.append((cdata[j], clabel[j]))
+            if not self._rx_rows:
+                break
+            if self.shuffle and self.shuffle_buffer > 1:
+                k = int(self._rx_rng.randint(len(self._rx_rows)))
+                self._rx_rows[k], self._rx_rows[-1] = (
+                    self._rx_rows[-1], self._rx_rows[k])
+                d, lab = self._rx_rows.pop()
+            else:
+                d, lab = self._rx_rows.popleft()
+            data[rows] = d
+            label[rows] = lab
+            rows += 1
+            self._cursor += 1
+        if rows == 0:
+            raise StopIteration
+        return self._emit(data, label, rows)
+
+    def _emit(self, data, label, rows):
+        from . import ndarray as nd
+
+        batch_nchw = np.transpose(data, (0, 3, 1, 2))
+        return DataBatch([nd.array(batch_nchw)], [nd.array(label)],
+                         self.batch_size - rows,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    # -- cursor --------------------------------------------------------
+
+    @property
+    def sample_position(self):
+        """Schedule entries consumed this epoch (the per-host sample
+        cursor the resume math multiplies back to a global position)."""
+        return self._cursor
+
+    def skip(self, num_batches):
+        """Reposition by ``num_batches`` without decoding: replay the
+        deterministic schedule in index space (strict mode) — pure
+        integer ops, no IO, so a resume lands exactly where the
+        interrupted run stopped. Relaxed mode has no deterministic
+        schedule to replay; it falls back to consume-and-drop."""
+        if not self.strict:
+            DataIter.skip(self, num_batches)
+            return
+        n = int(num_batches) * self.batch_size
+        while n > 0:
+            if not self._sched_buf:
+                self._refill_lookahead()
+                if not self._sched_buf:
+                    break
+            ci, _j = self._sched_buf.popleft()
+            self._consume_entry(ci)
+            n -= 1
+
+    def seek_sample(self, sample_pos):
+        """Absolute within-epoch repositioning to ``sample_pos``
+        (same index-space replay as :meth:`skip`; rewinding restarts
+        the epoch schedule first)."""
+        sample_pos = int(sample_pos)
+        if sample_pos < self._cursor:
+            self._restart_epoch()
+        whole, rem = divmod(sample_pos - self._cursor, self.batch_size)
+        if whole:
+            self.skip(whole)
+        n = rem
+        while n > 0:
+            if not self._sched_buf:
+                self._refill_lookahead()
+                if not self._sched_buf:
+                    break
+            ci, _j = self._sched_buf.popleft()
+            self._consume_entry(ci)
+            n -= 1
+
+    def _restart_epoch(self):
+        """Rebuild the CURRENT epoch's schedule from the top (seek
+        support) — unlike :meth:`reset`, the epoch number (and so the
+        shuffle order) is unchanged."""
+        self._drain_stale()
+        self._start_epoch()
+
+    def _drain_stale(self):
+        """Non-blocking drain of in-flight results so stale chunks from
+        a superseded schedule never pin queue capacity."""
+        pool = self._pool
+        if pool is None:
+            return
+        import queue as _q
+
+        while pool.inflight > 0:
+            try:
+                out = pool._results.get_nowait()
+            except _q.Empty:
+                break
+            pool.inflight -= 1
+            try:
+                self._accept(*out)
+            except MXNetError:
+                pass  # stale failure: its schedule is gone
+
+    def reset(self):
+        """Advance to the next epoch (fresh chunk order under
+        ``shuffle``). In-flight chunks from the previous epoch are
+        dropped on arrival via their epoch tag."""
+        self._drain_stale()
+        self._epoch += 1
+        self._start_epoch()
+
+    def close(self):
+        self._closed = True
+        if getattr(self, "_handle", None) is not None:
+            self._handle.close()
+            self._handle = None
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -540,15 +540,25 @@ class BaseModule(object):
                 metric_blob = pickle.dumps(eval_metric, protocol=2)
             except Exception:  # unpicklable custom metric (e.g. lambda
                 metric_blob = None  # feval): resume restarts its epoch
+            topo = self._topology()
+            # the streaming input pipeline's O(1) cursor: the global
+            # SAMPLE position is the topology-independent invariant
+            # (nbatch is only meaningful at the writer's global batch),
+            # recorded explicitly so MANIFEST readers — and a restoring
+            # world at any dp — can reposition without replaying batches
+            sample_pos = None
+            if topo and topo.get("global_batch"):
+                sample_pos = int(nbatch_done) * int(topo["global_batch"])
             return {
                 "module": self._capture_train_state(),
                 "epoch": int(epoch_next),
                 "nbatch": int(nbatch_done),
+                "sample_position": sample_pos,
                 "global_step": int(loop["gs"]),
                 "metric": metric_blob,
                 "rng": {"numpy": np.random.get_state(),
                         "mx": _rnd.get_state()},
-                "topology": self._topology(),
+                "topology": topo,
             }
 
         def _after_steps(epoch, done, n_new):

@@ -79,6 +79,51 @@ def world_size(default=0):
         return max(0, int(default))
 
 
+def host_count(default=1):
+    """How many host processes share the input dataset — the sharding
+    divisor for the streaming input pipeline's chunk shards
+    (``io_pipeline``). Resolution order: ``MXTPU_NUM_HOSTS`` (explicit
+    supervisor override, the host-level sibling of :data:`ENV_WORLD`),
+    ``DMLC_NUM_WORKER`` (launcher convention), then
+    ``jax.process_count()`` when jax is already up — never imported
+    here, so a data-only process stays backend-free."""
+    for name in ("MXTPU_NUM_HOSTS", "DMLC_NUM_WORKER"):
+        raw = os.environ.get(name)
+        if raw:
+            try:
+                return max(1, int(raw))
+            except ValueError:
+                pass
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            return max(1, int(sys.modules["jax"].process_count()))
+        except Exception:  # noqa: BLE001 — backend not initialized yet
+            pass
+    return max(1, int(default))
+
+
+def host_rank(default=0):
+    """This process's rank within :func:`host_count` (same resolution
+    order: ``MXTPU_HOST_RANK``, ``DMLC_RANK``, ``jax.process_index()``)."""
+    for name in ("MXTPU_HOST_RANK", "DMLC_RANK"):
+        raw = os.environ.get(name)
+        if raw:
+            try:
+                return max(0, int(raw))
+            except ValueError:
+                pass
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            return max(0, int(sys.modules["jax"].process_index()))
+        except Exception:  # noqa: BLE001
+            pass
+    return max(0, int(default))
+
+
 def make_mesh(dp=None, tp=1, pp=1, sp=1, ep=1, devices=None):
     """Create a Mesh with axes (dp, tp, pp, sp, ep). dp defaults to
     whatever is left after tp*pp*sp*ep. With ``devices=None`` the mesh

@@ -73,6 +73,14 @@ class MXRecordIO(object):
             pass
 
     def reset(self):
+        if self.writable:
+            # reopening with "wb" would silently truncate everything
+            # written so far — there is no sane meaning for "rewind" on
+            # a streaming writer, so make it an explicit error
+            raise MXNetError(
+                "%s: reset() on a write-mode MXRecordIO would truncate "
+                "the file; close() it and open a reader instead"
+                % self.uri)
         self.close()
         self.open()
 
@@ -208,6 +216,123 @@ class MXIndexedRecordIO(MXRecordIO):
         self.idx[key] = self.tell()
         self.keys.append(key)
         self.write(buf)
+
+
+# ---------------------------------------------------------------------------
+# Chunked byte-range access (streaming input pipeline, io_pipeline.py).
+#
+# A .rec file is a flat sequence of 4-byte-aligned records; any record
+# START offset is a valid resume point. Splitting the file into
+# byte-range chunks lets hosts read disjoint data (shard by
+# (host_rank, num_hosts)) and lets decode workers pull whole chunks
+# with one sequential read each — the dmlc-core InputSplit design the
+# reference's iter_image_recordio_2.cc builds on.
+
+#: One contiguous run of records: [start, end) byte range, the global
+#: ordinal of its first record, and how many records it holds.
+RecordChunk = collections.namedtuple(
+    "RecordChunk", ["start", "end", "ordinal", "n_records"])
+
+
+def scan_record_offsets(uri):
+    """Byte offset of every record start, by hopping header to header
+    (reads 8 bytes per record, never the payloads). The no-.idx
+    fallback for :func:`build_chunks`."""
+    offsets = []
+    size = os.path.getsize(uri)
+    with open(uri, "rb") as f:
+        pos = 0
+        while pos + 8 <= size:
+            f.seek(pos)
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            magic, lrec = _KMAGIC_STRUCT.unpack(header)
+            if magic != _MAGIC:
+                raise MXNetError(
+                    "%s: invalid record magic 0x%08x at offset %d"
+                    % (uri, magic, pos))
+            _, length = _decode_lrec(lrec)
+            offsets.append(pos)
+            pos += 8 + length + (4 - length % 4) % 4
+    return offsets
+
+
+def build_chunks(uri, idx_path=None, chunk_bytes=4 << 20):
+    """Split a .rec file into record-aligned byte-range chunks of at
+    least ``chunk_bytes`` each (the last one may be smaller). Offsets
+    come from the sibling .idx when given (O(records) text parse, no
+    data reads); otherwise from a header-hopping scan. Returns a list
+    of :class:`RecordChunk` covering every record exactly once, in
+    file order — shard it ``chunks[host_rank::num_hosts]`` for
+    disjoint per-host reads."""
+    offsets = None
+    if idx_path and os.path.isfile(idx_path):
+        offsets = []
+        with open(idx_path) as fin:
+            for line in fin:
+                line = line.strip()
+                if line:
+                    offsets.append(int(line.split("\t")[1]))
+        # .idx line order follows write order; a sorted/subset idx
+        # would misalign ordinals — normalize to file order
+        offsets.sort()
+    if not offsets:
+        offsets = scan_record_offsets(uri)
+    if not offsets:
+        return []
+    size = os.path.getsize(uri)
+    chunk_bytes = max(1, int(chunk_bytes))
+    chunks = []
+    start_i = 0
+    for i in range(1, len(offsets) + 1):
+        end = offsets[i] if i < len(offsets) else size
+        if end - offsets[start_i] >= chunk_bytes or i == len(offsets):
+            chunks.append(RecordChunk(
+                start=offsets[start_i], end=end, ordinal=start_i,
+                n_records=i - start_i))
+            start_i = i
+    return chunks
+
+
+def split_chunk(buf, uri="<chunk>", base_offset=0):
+    """Split one chunk's raw bytes into record payloads (the in-memory
+    analog of sequential :meth:`MXRecordIO.read` calls)."""
+    payloads = []
+    pos = 0
+    n = len(buf)
+    while pos + 8 <= n:
+        magic, lrec = _KMAGIC_STRUCT.unpack_from(buf, pos)
+        if magic != _MAGIC:
+            raise MXNetError(
+                "%s: invalid record magic 0x%08x at offset %d"
+                % (uri, magic, base_offset + pos))
+        _, length = _decode_lrec(lrec)
+        end = pos + 8 + length
+        if end > n:
+            raise MXNetError(
+                "%s: truncated record payload at offset %d"
+                % (uri, base_offset + pos))
+        payloads.append(bytes(buf[pos + 8:end]))
+        pos = end + (4 - length % 4) % 4
+    return payloads
+
+
+def read_chunk(handle, chunk, uri="<chunk>"):
+    """One sequential read of ``chunk``'s byte range through an open
+    binary ``handle``, split into record payloads."""
+    handle.seek(chunk.start)
+    buf = handle.read(chunk.end - chunk.start)
+    if len(buf) < chunk.end - chunk.start:
+        raise MXNetError(
+            "%s: truncated chunk [%d, %d) — file shrank under the reader"
+            % (uri, chunk.start, chunk.end))
+    payloads = split_chunk(buf, uri=uri, base_offset=chunk.start)
+    if len(payloads) != chunk.n_records:
+        raise MXNetError(
+            "%s: chunk at %d holds %d records, index said %d"
+            % (uri, chunk.start, len(payloads), chunk.n_records))
+    return payloads
 
 
 # The user-facing header is a namedtuple exactly like the reference

@@ -414,6 +414,12 @@ class CheckpointManager:
         # itself is named-tree / layout-independent by design.
         if state.get("topology"):
             manifest["topology"] = state["topology"]
+        # The input pipeline's O(1) cursor: global sample position at
+        # snapshot time. Readers (resume at any dp, MANIFEST inspection,
+        # StreamingImageRecordIter.seek_sample) reposition from this
+        # single integer — no batch replay, no decode.
+        if state.get("sample_position") is not None:
+            manifest["sample_position"] = int(state["sample_position"])
         payload = json.dumps(manifest, indent=1, sort_keys=True).encode()
         _write_member(tmp, MANIFEST, payload)
         return sum(m["bytes"] for m in files.values()) + len(payload)

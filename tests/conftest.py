@@ -28,3 +28,37 @@ def _seed():
 
     mx.random.seed(0)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): SIGALRM deadline for one test — guards the "
+        "multi-process input-pipeline tests against a hung decode pool "
+        "taking the whole tier-1 run down with it")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Minimal in-tree stand-in for pytest-timeout (not vendored here):
+    an alarm-based deadline honored on the main thread. A test that
+    deadlocks on a worker queue fails with a clear message instead of
+    eating the suite's global `timeout` budget."""
+    import signal
+
+    marker = item.get_closest_marker("timeout")
+    seconds = int(marker.args[0]) if marker and marker.args else 0
+    if seconds <= 0 or not hasattr(signal, "SIGALRM"):
+        return (yield)
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            "test exceeded its %ds timeout marker" % seconds)
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

@@ -85,6 +85,25 @@ def test_recordio_roundtrip(tmp_path):
     reader.close()
 
 
+def test_recordio_writer_reset_refuses_truncation(tmp_path):
+    """reset() on a write-mode MXRecordIO used to reopen with "wb" and
+    silently truncate everything written so far; it must now raise and
+    leave the data intact."""
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(3):
+        writer.write(b"keep%d" % i)
+    with pytest.raises(mx.base.MXNetError, match="truncate"):
+        writer.reset()
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    assert [reader.read() for _ in range(3)] == \
+        [b"keep0", b"keep1", b"keep2"]
+    reader.reset()  # read-mode reset still rewinds
+    assert reader.read() == b"keep0"
+    reader.close()
+
+
 def test_indexed_recordio(tmp_path):
     path = str(tmp_path / "test.rec")
     idx_path = str(tmp_path / "test.idx")

@@ -193,6 +193,32 @@ def amp_advice(records):
     return None
 
 
+def input_advice(ranked, metrics=None):
+    """The streaming-input misconfiguration the anatomy stream exposes:
+    ``input_wait`` (backed by the ``io.feed_wait_seconds`` histogram)
+    ranked as the largest phase means the device is eating batches
+    faster than the host decodes them. The fix is the process decode
+    pool, not deeper prefetch — a deeper buffer only delays the same
+    starvation. Returns an advice string, or None when input is not the
+    diagnosis."""
+    if not ranked or ranked[0][0] != "input_wait" or ranked[0][1] <= 0.0:
+        return None
+    depth = None
+    qd = (metrics or {}).get("io.queue_depth")
+    for stream in (qd or {}).get("streams", []):
+        if stream.get("labels", {}).get("queue") == "ready":
+            depth = stream.get("value")
+    detail = ""
+    if depth is not None:
+        detail = (" (io.queue_depth ready=%g: %s)" %
+                  (depth, "decode pool keeping up — feed handoff is the "
+                          "gap" if depth and depth > 0
+                   else "decode pool empty — workers are the bottleneck"))
+    return ("input-bound — raise MXTPU_INPUT_WORKERS / check "
+            "io.queue_depth%s; see docs/performance.md \"Streaming "
+            "input pipeline\"" % detail)
+
+
 def _step_latency_percentiles(metrics):
     """p50/p99 of fit.step_seconds from the last metrics snapshot, using
     the same bucket interpolation as the live registry (the snapshot
@@ -377,6 +403,10 @@ def report(path, keep_all=False):
     if amp:
         out.append(amp)
 
+    inp = input_advice(ranked, metrics)
+    if inp:
+        out.append(inp)
+
     kc = kernel_candidates_section(op_costs, anatomy)
     if kc:
         out += ["", kc]
@@ -493,8 +523,23 @@ def _self_test():
                                    dtype="f32", kind="cpu")]) is None
     assert amp_advice([anatomy_rec(0, dict(base), 0.01)]) is None
 
+    # input-bound advice fires when input_wait is the diagnosis, and
+    # folds in the io.queue_depth reading when the snapshot carries it
+    starve = dict(base)
+    starve["input_wait"] = 0.5
+    starve_ranked, _, _ = diagnose([anatomy_rec(0, starve, 0.01)])
+    msg = input_advice(starve_ranked) or ""
+    assert "input-bound — raise MXTPU_INPUT_WORKERS / check " \
+           "io.queue_depth" in msg, msg
+    msg = input_advice(starve_ranked, {"io.queue_depth": {
+        "kind": "gauge", "streams": [
+            {"labels": {"queue": "ready"}, "value": 0.0}]}}) or ""
+    assert "workers are the bottleneck" in msg, msg
+    assert input_advice(ranked) is None, ranked  # device_sync diagnosis
+
     text = report(path)
     assert "diagnosis: largest cost is device_sync" in text, text
+    assert "input-bound" not in text, text
     assert "compute-bound" in text, text
     assert "fp32 compute on TPU" in text, text
     assert "2x data.shape" in text, text

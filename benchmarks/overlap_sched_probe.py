@@ -205,7 +205,8 @@ def build_step(jax, mesh):
     }
     lowered = st._step.lower(
         params, aux, opt, batch, jnp.zeros((2,), jnp.uint32),
-        jnp.asarray(0.1, jnp.float32), jnp.asarray(1.0, jnp.float32))
+        jnp.asarray(0.1, jnp.float32), jnp.asarray(1.0, jnp.float32),
+        jnp.asarray(jnp.inf, jnp.float32))  # guard gate open
     return lowered
 
 

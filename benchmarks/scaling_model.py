@@ -128,7 +128,8 @@ def comm_bytes_for(jax, jnp, mx, sym, n_dev, per_chip_batch, spatial):
     lowered = step._step.lower(
         params, aux, opt_state, batch_in,
         jnp.zeros((2,), jnp.uint32), jnp.asarray(0.1, jnp.float32),
-        jnp.asarray(1.0, jnp.float32))
+        jnp.asarray(1.0, jnp.float32),
+        jnp.asarray(jnp.inf, jnp.float32))  # guard gate open
     hlo = lowered.compile().as_text()
     sizes, counts = hlo_allreduce_bytes(hlo)
     param_bytes = sum(

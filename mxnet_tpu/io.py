@@ -383,6 +383,17 @@ class DeviceFeedIter(DataIter):
         self.iter.reset()
         self._fill()
 
+    def rewind(self, seek_inner):
+        """Guardrail rewind repositioning: drop every staged transfer
+        (mid-flight abandonment is safe — jax arrays are immutable),
+        hand the INNER iterator to ``seek_inner`` for repositioning
+        (``seek_epoch``/``reset``), then restage from the new cursor."""
+        self._staged.clear()
+        self._exhausted = False
+        self.current_batch = None
+        seek_inner(self.iter)
+        self._fill()
+
     def skip(self, num_batches):
         """Resume repositioning: drop already-staged transfers first
         (their references die; jax arrays are immutable so mid-flight

@@ -37,6 +37,8 @@ overlaps compute, and input work stops appearing in
 from __future__ import annotations
 
 import atexit
+import json
+import logging
 import os
 import random as _pyrandom
 import re
@@ -51,10 +53,14 @@ from . import telemetry as _tm
 from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
 
+logger = logging.getLogger(__name__)
+
 ENV_WORKERS = "MXTPU_INPUT_WORKERS"
 ENV_SHUFFLE_BUFFER = "MXTPU_SHUFFLE_BUFFER"
 ENV_CHUNK_BYTES = "MXTPU_INPUT_CHUNK_BYTES"
 ENV_STRICT_ORDER = "MXTPU_INPUT_STRICT_ORDER"
+ENV_BAD_RECORD_BUDGET = "MXTPU_BAD_RECORD_BUDGET"
+ENV_QUARANTINE_FILE = "MXTPU_QUARANTINE_FILE"
 
 _H_DECODE = _tm.histogram(
     "io.decode_seconds",
@@ -69,6 +75,15 @@ _G_QDEPTH = _tm.gauge(
 _C_BYTES = _tm.counter(
     "io.bytes_read",
     "Raw .rec bytes pulled through the streaming input pipeline")
+_C_BAD = _tm.counter(
+    "io.bad_records",
+    "Undecodable records quarantined by the streaming input pipeline "
+    "(skipped and logged; the run fails once MXTPU_BAD_RECORD_BUDGET "
+    "is exceeded)")
+_C_RESUB = _tm.counter(
+    "io.worker_resubmits",
+    "Chunk tasks resubmitted to surviving decode workers after a "
+    "worker died with tasks in flight")
 
 
 def _env_int(name, default):
@@ -142,19 +157,27 @@ def _build_augmenters(data_shape, recipe):
 
 def _decode_chunk_payloads(payloads, ordinal0, cfg, auglist):
     """Decode+augment one chunk's record payloads into contiguous batch
-    slabs: ``(data[n,h,w,c] f32, label[n(,label_width)] f32, valid[n])``.
+    slabs: ``(data[n,h,w,c] f32, label[n(,label_width)] f32, valid[n],
+    bad)`` where ``bad`` lists ``(global_ordinal, reason)`` for every
+    record that failed to decode — the quarantine ledger. A bad record
+    is a counted, budgeted event, never a silent skip (the caller
+    charges it against ``MXTPU_BAD_RECORD_BUDGET``).
 
     Per-sample determinism: when ``cfg['seed']`` is set, the global RNGs
     are seeded from the record's global ordinal before its augment chain
     runs (and restored afterwards), so the draw sequence depends only on
     WHICH sample is augmented — never on which worker got it or how the
     chunk was batched."""
+    fault = None
+    if os.environ.get("MXTPU_FAULT_INJECT"):
+        from .resilience import fault
     c, h, w = cfg["data_shape"]
     lw = int(cfg.get("label_width", 1))
     n = len(payloads)
     data = np.zeros((n, h, w, c), np.float32)
     label = np.zeros((n,) if lw == 1 else (n, lw), np.float32)
     valid = np.zeros((n,), np.bool_)
+    bad = []
     seed = cfg.get("seed")
     saved = None
     if seed is not None:
@@ -162,6 +185,9 @@ def _decode_chunk_payloads(payloads, ordinal0, cfg, auglist):
     try:
         for j, s in enumerate(payloads):
             try:
+                if fault is not None:
+                    fault.fire("record_decode", uri=cfg.get("uri"),
+                               ordinal=ordinal0 + j)
                 header, img = recordio.unpack(s)
                 if seed is not None:
                     sj = _mix_seed(seed, ordinal0 + j)
@@ -169,6 +195,7 @@ def _decode_chunk_payloads(payloads, ordinal0, cfg, auglist):
                     np.random.seed(sj & 0xFFFFFFFF)
                 arr = recordio._imdecode_np(bytes(img), 1)
                 if arr is None or arr.size == 0:
+                    bad.append((ordinal0 + j, "empty or undecodable image"))
                     continue
                 arr = np.asarray(arr, np.float32)
                 if arr.ndim == 2:
@@ -188,25 +215,34 @@ def _decode_chunk_payloads(payloads, ordinal0, cfg, auglist):
                 else:
                     label[j, :min(lw, lab.size)] = lab[:lw]
                 valid[j] = True
-            except (MXNetError, OSError, ValueError):
-                continue  # undecodable image: the assembler pulls a
-                # replacement from the schedule
+            except (MXNetError, OSError, ValueError) as exc:
+                # undecodable record: the assembler pulls a replacement
+                # from the schedule — but the event is LEDGERED, never
+                # silently swallowed (quarantine JSONL + budget)
+                bad.append((ordinal0 + j,
+                            "%s: %s" % (type(exc).__name__, exc)))
+                continue
     finally:
         if saved is not None:
             _pyrandom.setstate(saved[0])
             np.random.set_state(saved[1])
-    return data, label, valid
+    return data, label, valid, bad
 
 
-def _worker_main(task_q, result_q, cfg):
+def _worker_main(task_r, result_w, cfg):
     """Decode-worker loop (spawned child). Tasks are chunk descriptors
-    ``(seq, start, end, ordinal, n_records)``; the worker reads its own
-    byte range (disjoint from every other worker's), decodes, and ships
-    slabs back. ``None`` is the shutdown sentinel."""
+    ``(seq, start, end, ordinal, n_records)`` arriving on this worker's
+    OWN task pipe; decoded slabs leave on its own result pipe. ``None``
+    (or the parent closing the pipe) is the shutdown signal. Per-worker
+    pipes — never shared queues — so this process dying mid-read or
+    mid-write can corrupt nobody else's channel."""
     auglist = _build_augmenters(cfg["data_shape"], cfg.get("recipe"))
     handle = open(cfg["uri"], "rb")
     while True:
-        task = task_q.get()
+        try:
+            task = task_r.recv()
+        except (EOFError, OSError):
+            break
         if task is None:
             break
         seq, start, end, ordinal, n_records = task
@@ -216,14 +252,18 @@ def _worker_main(task_q, result_q, cfg):
                 handle, recordio.RecordChunk(start, end, ordinal,
                                              n_records),
                 uri=cfg["uri"])
-            data, label, valid = _decode_chunk_payloads(
+            data, label, valid, bad = _decode_chunk_payloads(
                 payloads, ordinal, cfg, auglist)
-            result_q.put((seq, data, label, valid, end - start,
-                          time.perf_counter() - t0, None))
+            out = (seq, data, label, valid, bad, end - start,
+                   time.perf_counter() - t0, None)
         except BaseException as e:  # noqa: BLE001 — surfaced in parent
-            result_q.put((seq, None, None, None, 0,
-                          time.perf_counter() - t0,
-                          "%s: %s" % (type(e).__name__, e)))
+            out = (seq, None, None, None, [], 0,
+                   time.perf_counter() - t0,
+                   "%s: %s" % (type(e).__name__, e))
+        try:
+            result_w.send(out)
+        except (BrokenPipeError, OSError):
+            break  # parent is gone — nothing left to report to
 
 
 def _child_env():
@@ -260,10 +300,17 @@ atexit.register(shutdown_all)
 class DecodePool:
     """Spawn-safe process pool moving chunk decode off the GIL.
 
-    Bounded queues in both directions: task puts block when workers
-    fall behind (the parent stops reading ahead), result puts block
-    when the consumer falls behind (workers stop decoding) — the
-    ThreadedIter producer/consumer contract, across processes.
+    Every worker gets its OWN task pipe and result pipe (parent sole
+    writer / sole reader respectively) instead of queues shared across
+    workers: a SIGKILLed worker holding a shared queue's lock — or dead
+    mid-write into a shared pipe — would wedge every survivor, while a
+    private channel dies with its owner and the parent simply stops
+    reading it. Death is detected by pipe EOF (the child's fd copies
+    close with it), so recovery needs no polling.
+
+    Backpressure is preserved: the parent's ``_pump`` never submits
+    past ``capacity`` chunks in flight, and a worker whose result
+    outruns the consumer blocks in ``send`` on its own pipe.
     """
 
     def __init__(self, workers, cfg, capacity=None):
@@ -271,21 +318,34 @@ class DecodePool:
 
         ctx = mp.get_context("spawn")
         self.capacity = int(capacity or max(2 * workers, 4))
-        self._tasks = ctx.Queue(self.capacity)
-        self._results = ctx.Queue(self.capacity)
         self.inflight = 0
         self._procs = []
+        self._task_w = []    # parent->worker send ends (None = dead)
+        self._result_r = []  # worker->parent recv ends (None = dead)
+        self._assigned = []  # per-worker {seq: task} not yet delivered
+        self._resub_count = {}  # seq -> resubmissions (cap 1)
+        self._resubmitted = False
         saved = {}
         try:
             for k, v in _child_env().items():
                 saved[k] = os.environ.get(k)
                 os.environ[k] = v
             for _ in range(int(workers)):
+                task_r, task_w = ctx.Pipe(duplex=False)
+                result_r, result_w = ctx.Pipe(duplex=False)
                 p = ctx.Process(target=_worker_main,
-                                args=(self._tasks, self._results, cfg),
+                                args=(task_r, result_w, cfg),
                                 daemon=True)
                 p.start()
+                # drop the parent's copies of the child's ends so the
+                # child dying closes the last write fd of its result
+                # pipe — that EOF is the death signal
+                task_r.close()
+                result_w.close()
                 self._procs.append(p)
+                self._task_w.append(task_w)
+                self._result_r.append(result_r)
+                self._assigned.append({})
         finally:
             for k, v in saved.items():
                 if v is None:
@@ -294,50 +354,129 @@ class DecodePool:
                     os.environ[k] = v
         _LIVE_POOLS.add(self)
 
+    def _live(self):
+        return [i for i, c in enumerate(self._result_r) if c is not None]
+
     def submit(self, task):
-        self._tasks.put(task)
+        self._route(task)
         self.inflight += 1
+
+    def _route(self, task):
+        """Hand a task to the least-loaded live worker; a send that
+        hits a broken pipe reaps that worker (resubmitting its
+        orphans) and retries on the survivors."""
+        while True:
+            live = self._live()
+            if not live:
+                raise MXNetError(
+                    "input pipeline: all decode workers exited with "
+                    "%d chunk(s) outstanding" % self.inflight)
+            i = min(live, key=lambda j: len(self._assigned[j]))
+            try:
+                self._task_w[i].send(task)
+            except (BrokenPipeError, OSError):
+                self._mark_dead(i)
+                continue
+            self._assigned[i][task[0]] = task
+            return
+
+    def _mark_dead(self, i):
+        """Close a dead worker's channels and resubmit its undelivered
+        tasks to the survivors — each task at most ONCE: a chunk whose
+        second host also died is evidence of a poison chunk (or a sick
+        box), not bad luck, and retrying it forever would loop."""
+        if self._result_r[i] is None:
+            return
+        for conn in (self._result_r[i], self._task_w[i]):
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._result_r[i] = None
+        self._task_w[i] = None
+        orphans, self._assigned[i] = self._assigned[i], {}
+        if not orphans:
+            return
+        twice = [s for s in orphans if self._resub_count.get(s)]
+        if twice:
+            raise MXNetError(
+                "input pipeline: decode worker died re-running "
+                "resubmitted chunk(s) %s — giving up rather than "
+                "looping on a poison chunk" % sorted(twice))
+        self._resubmitted = True
+        _C_RESUB.inc(len(orphans))
+        logger.warning(
+            "input pipeline: decode worker %d died; resubmitting its "
+            "%d in-flight chunk(s) to %d survivor(s)",
+            i, len(orphans), len(self._live()))
+        for seq, task in orphans.items():
+            self._resub_count[seq] = 1
+            self._route(task)
 
     def get(self, timeout=300.0):
         """One result tuple, surfacing worker-side failures. The
         timeout is a deadlock guard, not a latency bound: it only
-        expires when every worker died without answering."""
-        import queue as _q
+        expires when no worker answers at all.
+
+        Worker death shows up as EOF on that worker's result pipe
+        (buffered complete results still arrive first); its
+        undelivered tasks are resubmitted once to the survivors. Death
+        of every worker — or a resubmitted task dying again — fails
+        the epoch."""
+        from multiprocessing import connection as _mpc
 
         deadline = time.monotonic() + timeout
         while True:
-            try:
-                out = self._results.get(timeout=1.0)
-                self.inflight -= 1
-                return out
-            except _q.Empty:
-                if not any(p.is_alive() for p in self._procs):
-                    raise MXNetError(
-                        "input pipeline: all decode workers exited with "
-                        "%d chunk(s) outstanding" % self.inflight)
+            conns = [c for c in self._result_r if c is not None]
+            if not conns:
+                raise MXNetError(
+                    "input pipeline: all decode workers exited with "
+                    "%d chunk(s) outstanding" % self.inflight)
+            ready = _mpc.wait(conns, timeout=1.0)
+            if not ready:
                 if time.monotonic() > deadline:
                     raise MXNetError(
                         "input pipeline: no decode result within %.0fs "
                         "(%d in flight)" % (timeout, self.inflight))
+                continue
+            conn = ready[0]
+            i = self._result_r.index(conn)
+            try:
+                out = conn.recv()
+            except (EOFError, OSError):
+                self._mark_dead(i)
+                continue
+            seq = out[0]
+            self._assigned[i].pop(seq, None)
+            self._resub_count.pop(seq, None)
+            self.inflight -= 1
+            return out
 
     def close(self):
         procs, self._procs = self._procs, []
         if not procs:
             return
-        for _ in procs:
+        for w in self._task_w:
+            if w is None:
+                continue
             try:
-                self._tasks.put_nowait(None)
-            except Exception:  # noqa: BLE001 — full queue: terminate below
-                break
+                w.send(None)
+            except (BrokenPipeError, OSError):
+                pass
         for p in procs:
             p.join(timeout=2.0)
         for p in procs:
             if p.is_alive():
                 p.terminate()
                 p.join(timeout=2.0)
-        for q in (self._tasks, self._results):
-            q.cancel_join_thread()
-            q.close()
+        for conn in self._task_w + self._result_r:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        self._task_w = []
+        self._result_r = []
 
     def __del__(self):
         try:
@@ -437,6 +576,12 @@ class StreamingImageRecordIter(DataIter):
         self._pool = None
         self._epoch = 0
         self._closed = False
+        # poison-data quarantine: undecodable records are counted,
+        # named in the quarantine JSONL, and budgeted — a dataset rot
+        # past MXTPU_BAD_RECORD_BUDGET fails the run instead of
+        # silently training on less data
+        self.bad_records = 0
+        self._bad_budget = max(0, _env_int(ENV_BAD_RECORD_BUDGET, 100))
         self._start_epoch()
 
     # -- epoch schedule ------------------------------------------------
@@ -541,12 +686,16 @@ class StreamingImageRecordIter(DataIter):
             self._seq += 1
         _G_QDEPTH.set(pool.inflight, queue="tasks")
 
-    def _accept(self, seq, data, label, valid, nbytes, secs, err):
+    def _accept(self, seq, data, label, valid, bad, nbytes, secs, err):
         """Fold one pool result into the cache (dropping stale epochs
-        and already-skipped chunks)."""
+        and already-skipped chunks). Bad records are ledgered BEFORE
+        the staleness check — the decode failure happened on real file
+        bytes regardless of whether the schedule still wants them."""
         if err is not None:
             raise MXNetError("input pipeline worker failed: %s" % err)
         epoch, ci = self._seq_meta.pop(seq, (None, None))
+        if bad:
+            self._record_bad(ci, bad)
         _H_DECODE.observe(secs, mode="process")
         _C_BYTES.inc(nbytes)
         if epoch != self._epoch or self._remaining.get(ci, 0) <= 0:
@@ -554,6 +703,45 @@ class StreamingImageRecordIter(DataIter):
         self._cache[ci] = (data, label, valid)
         _G_QDEPTH.set(len(self._cache), queue="ready")
         return ci
+
+    def _quarantine_path(self):
+        path = os.environ.get(ENV_QUARANTINE_FILE)
+        if path:
+            return path
+        run_dir = os.environ.get("MXTPU_RUN_DIR")
+        if run_dir:
+            return os.path.join(run_dir, "quarantine.jsonl")
+        return None
+
+    def _record_bad(self, ci, bad):
+        """Quarantine bookkeeping for undecodable records: bump the
+        ``io.bad_records`` counter, name each one in the quarantine
+        JSONL (uri/chunk/ordinal/reason — a rewind or a data audit can
+        point at the exact record), and raise once the budget is spent:
+        silently training on less data than scheduled is an outage."""
+        self.bad_records += len(bad)
+        _C_BAD.inc(len(bad))
+        path = self._quarantine_path()
+        if path:
+            try:
+                with open(path, "a") as f:
+                    for ordinal, reason in bad:
+                        f.write(json.dumps({
+                            "type": "quarantine",
+                            "uri": self.uri,
+                            "chunk": None if ci is None else int(ci),
+                            "ordinal": int(ordinal),
+                            "reason": str(reason),
+                            "t": time.time(),
+                        }) + "\n")
+            except OSError:
+                pass  # the counter and the budget still stand
+        if self.bad_records > self._bad_budget:
+            raise MXNetError(
+                "input pipeline: %d undecodable record(s) in %s exceeds "
+                "MXTPU_BAD_RECORD_BUDGET=%d (quarantine log: %s)"
+                % (self.bad_records, self.uri, self._bad_budget,
+                   path or "<none>"))
 
     def _decode_inline(self, ci):
         if self._auglist is None:
@@ -564,11 +752,13 @@ class StreamingImageRecordIter(DataIter):
         if getattr(self, "_handle", None) is None:
             self._handle = open(self.uri, "rb")
         payloads = recordio.read_chunk(self._handle, ch, uri=self.uri)
-        out = _decode_chunk_payloads(
+        data, label, valid, bad = _decode_chunk_payloads(
             payloads, ch.ordinal, self._cfg, self._auglist)
         _H_DECODE.observe(time.perf_counter() - t0, mode="inline")
         _C_BYTES.inc(ch.end - ch.start)
-        return out
+        if bad:
+            self._record_bad(ci, bad)
+        return data, label, valid
 
     def _get_chunk(self, ci):
         """The chunk's decoded slabs — from cache, the pool (blocking on
@@ -759,6 +949,16 @@ class StreamingImageRecordIter(DataIter):
         self._drain_stale()
         self._start_epoch()
 
+    def seek_epoch(self, epoch):
+        """Reposition to the START of absolute epoch ``epoch``
+        (guardrail rewind support): unlike :meth:`reset` the epoch
+        counter is SET, not incremented, so the schedule RNG — and with
+        it the shuffle order — replays that epoch's original pass
+        exactly. O(1): pure schedule state, no decode, no IO."""
+        self._drain_stale()
+        self._epoch = int(epoch)
+        self._start_epoch()
+
     def _drain_stale(self):
         """Non-blocking drain of in-flight results so stale chunks from
         a superseded schedule never pin queue capacity."""
@@ -772,6 +972,9 @@ class StreamingImageRecordIter(DataIter):
                 out = pool._results.get_nowait()
             except _q.Empty:
                 break
+            if out[0] in pool._delivered:
+                continue  # duplicate completion after a resubmit
+            pool._pending.pop(out[0], None)
             pool.inflight -= 1
             try:
                 self._accept(*out)

@@ -10,6 +10,7 @@ param-file codec, instead of the reference's per-method inline loops.
 from __future__ import annotations
 
 import collections
+import copy
 import logging
 import os
 import pickle
@@ -59,6 +60,20 @@ def _fire(callbacks, epoch, nbatch, eval_metric, local_vars):
                            eval_metric=eval_metric, locals=local_vars)
     for cb in _as_list(callbacks):
         cb(params)
+
+
+def _poison_batch(batch, mode):
+    """Fault-injection support (``nan_grad_at_step`` /
+    ``loss_spike_at_step``): a shallow copy of ``batch`` whose data is
+    poisoned — NaN (non-finite gradient) or a 1e4 scale (finite loss /
+    grad-norm spike) — with labels and metadata intact, so the
+    guardrail sees exactly what a corrupt upstream feed would produce."""
+    factor = float("nan") if mode == "nan" else 1.0e4
+    out = copy.copy(batch)
+    out.data = [
+        nd.array(np.asarray(d.asnumpy(), dtype=np.float32) * factor)
+        for d in batch.data]
+    return out
 
 
 def _check_input_names(symbol, names, typename, throw):
@@ -292,7 +307,8 @@ class BaseModule(object):
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, checkpoint_dir=None, resume=None):
+            monitor=None, checkpoint_dir=None, resume=None,
+            guardrails=None):
         """THE training loop — parity base_module.py:368-516 (§3.1).
 
         Preemption-safe extension (docs/robustness.md): ``checkpoint_dir``
@@ -304,7 +320,16 @@ class BaseModule(object):
         step number) restores params, optimizer state, RNG streams,
         metric accumulation, and the data-iterator position from the
         newest checkpoint whose manifest verifies — continuation is
-        bitwise-identical to a run that was never interrupted."""
+        bitwise-identical to a run that was never interrupted.
+
+        ``guardrails="auto"`` (requires ``checkpoint_dir``) arms the
+        numeric guardrails (resilience/guardrail.py): the fused step
+        gains a branchless skip gate on non-finite / out-of-threshold
+        gradients, a robust z-score monitor watches loss and grad-norm,
+        checkpoints carry a ``health`` stamp, and repeated anomalies
+        rewind to the newest known-good snapshot — bounded by
+        ``MXTPU_GUARD_MAX_REWINDS``, after which the run exits
+        ``EXIT_GUARDRAIL`` with a structured verdict."""
         assert num_epoch is not None, "please specify number of epochs"
         self.bind(
             data_shapes=train_data.provide_data,
@@ -402,12 +427,93 @@ class BaseModule(object):
         except ValueError:
             ckpt_interval = 0
 
+        # -- training guardrails (resilience/guardrail.py) -------------
+        from ..resilience import guardrail as _guard
+
+        guard_mon = None
+        if guardrails is not None:
+            if guardrails != "auto":
+                raise ValueError(
+                    'guardrails must be "auto" or None, got %r'
+                    % (guardrails,))
+            if ckpt_mgr is None:
+                raise ValueError(
+                    "fit(guardrails=...) requires checkpoint_dir — "
+                    "rewind-to-last-good needs somewhere to rewind to")
+            if _trainer is None:
+                # the in-graph gate and diag stream live in the fused
+                # step; without it there is nothing to observe
+                self.logger.warning(
+                    "guardrails: no fused trainer on this module — "
+                    "anomaly detection disabled")
+            else:
+                _trainer.arm_guard()
+                guard_mon = _guard.GuardrailMonitor(logger=self.logger)
+
+        def _restore_from_state(state):
+            """Reinstate module/optimizer/RNG (+ the elastic cursor
+            translation) from a checkpoint state dict. Shared by the
+            resume path and the guardrail rewind path. Returns
+            ``(epoch, skip, gs, metric_blob)``."""
+            self._restore_train_state(state["module"])
+            rng = state.get("rng") or {}
+            if rng.get("numpy") is not None:
+                np.random.set_state(rng["numpy"])
+            if rng.get("mx") is not None:
+                _rnd.set_state(rng["mx"])
+            epoch = int(state.get("epoch", 0))
+            skip = int(state.get("nbatch", 0))
+            gs = int(state.get("global_step", 0))
+            metric_blob = state.get("metric")
+            # -- elastic resume (docs/robustness.md) -------------------
+            # The snapshot is layout-independent (named trees;
+            # _restore_train_state just re-sharded the optimizer
+            # slabs at THIS world's dp), but the iterator cursor
+            # counts batches at the WRITER's global batch. When the
+            # restoring world feeds a different global batch,
+            # translate through the invariant that actually matters:
+            # the global SAMPLE position.
+            topo = state.get("topology")
+            cur = self._topology()
+            if topo and cur:
+                wgb = int(topo.get("global_batch") or 0)
+                cgb = int(cur.get("global_batch") or 0)
+                if wgb and cgb and wgb != cgb:
+                    samples = skip * wgb
+                    skip, rem = divmod(samples, cgb)
+                    if rem:
+                        # round DOWN: re-feeding (<1 batch of) seen
+                        # samples beats silently skipping unseen ones
+                        self.logger.warning(
+                            "elastic resume: sample position %d is "
+                            "not a multiple of the new global batch "
+                            "%d — %d samples will be re-fed",
+                            samples, cgb, rem)
+                    # the saved metric accumulated at the old batch
+                    # geometry; with the cursor translated it still
+                    # covers exactly the samples trained so far
+                if topo.get("dp") != cur.get("dp"):
+                    self.logger.info(
+                        "elastic resume: checkpoint written at dp=%s "
+                        "(global batch %s), restoring at dp=%s "
+                        "(global batch %s) — optimizer state "
+                        "re-sharded across %s replicas",
+                        topo.get("dp"), wgb or "?", cur.get("dp"),
+                        cgb or "?", cur.get("dp"))
+            ckpt_mgr.last_step = gs
+            return epoch, skip, gs, metric_blob
+
         resume_skip = 0
         resume_metric = None
         gs0 = 0
         if ckpt_mgr is not None and resume is not None:
             if resume == "auto":
-                state = ckpt_mgr.load()
+                # under guardrails, prefer the newest HEALTHY snapshot:
+                # a checkpoint stamped mid-anomaly would resume the very
+                # divergence the rewind was escaping (the SIGKILL-
+                # during-rewind chain relaunches through here)
+                state = (ckpt_mgr.load_last_good()
+                         if guard_mon is not None else ckpt_mgr.load())
             elif isinstance(resume, int) and not isinstance(resume, bool):
                 state = ckpt_mgr.load(step=resume)
             else:
@@ -420,52 +526,11 @@ class BaseModule(object):
                     "resume: no valid checkpoint under %s — starting fresh",
                     ckpt_mgr.directory)
             else:
-                self._restore_train_state(state["module"])
-                rng = state.get("rng") or {}
-                if rng.get("numpy") is not None:
-                    np.random.set_state(rng["numpy"])
-                if rng.get("mx") is not None:
-                    _rnd.set_state(rng["mx"])
-                begin_epoch = int(state.get("epoch", begin_epoch))
-                resume_skip = int(state.get("nbatch", 0))
-                gs0 = int(state.get("global_step", 0))
-                resume_metric = state.get("metric")
-                # -- elastic resume (docs/robustness.md) ---------------
-                # The snapshot is layout-independent (named trees;
-                # _restore_train_state just re-sharded the optimizer
-                # slabs at THIS world's dp), but the iterator cursor
-                # counts batches at the WRITER's global batch. When the
-                # restoring world feeds a different global batch,
-                # translate through the invariant that actually matters:
-                # the global SAMPLE position.
-                topo = state.get("topology")
-                cur = self._topology()
-                if topo and cur:
-                    wgb = int(topo.get("global_batch") or 0)
-                    cgb = int(cur.get("global_batch") or 0)
-                    if wgb and cgb and wgb != cgb:
-                        samples = resume_skip * wgb
-                        resume_skip, rem = divmod(samples, cgb)
-                        if rem:
-                            # round DOWN: re-feeding (<1 batch of) seen
-                            # samples beats silently skipping unseen ones
-                            self.logger.warning(
-                                "elastic resume: sample position %d is "
-                                "not a multiple of the new global batch "
-                                "%d — %d samples will be re-fed",
-                                samples, cgb, rem)
-                        # the saved metric accumulated at the old batch
-                        # geometry; with the cursor translated it still
-                        # covers exactly the samples trained so far
-                    if topo.get("dp") != cur.get("dp"):
-                        self.logger.info(
-                            "elastic resume: checkpoint written at dp=%s "
-                            "(global batch %s), restoring at dp=%s "
-                            "(global batch %s) — optimizer state "
-                            "re-sharded across %s replicas",
-                            topo.get("dp"), wgb or "?", cur.get("dp"),
-                            cgb or "?", cur.get("dp"))
-                ckpt_mgr.last_step = gs0
+                begin_epoch, resume_skip, gs0, resume_metric = \
+                    _restore_from_state(state)
+                if guard_mon is not None:
+                    guard_mon.restore(state.get("health"))
+                    _trainer.guard_threshold = guard_mon.gate_threshold()
                 _C_RESUME_LOADED.inc()
                 self.logger.info(
                     "resume: restored step %d (epoch %d, batch %d)",
@@ -549,7 +614,7 @@ class BaseModule(object):
             sample_pos = None
             if topo and topo.get("global_batch"):
                 sample_pos = int(nbatch_done) * int(topo["global_batch"])
-            return {
+            blob = {
                 "module": self._capture_train_state(),
                 "epoch": int(epoch_next),
                 "nbatch": int(nbatch_done),
@@ -560,6 +625,12 @@ class BaseModule(object):
                         "mx": _rnd.get_state()},
                 "topology": topo,
             }
+            if guard_mon is not None:
+                # health stamp: known-clean flag + detector state, so
+                # retention can protect the rewind target and a rewind
+                # restarts the statistics where this snapshot left them
+                blob["health"] = guard_mon.health_blob(loop["gs"])
+            return blob
 
         def _after_steps(epoch, done, n_new):
             """Bookkeeping after ``n_new`` batches finished training
@@ -577,6 +648,22 @@ class BaseModule(object):
             _tm.anatomy.on_steps(n_new)
             if fleet_hb is not None:
                 fleet_hb.progress(n_new)
+            if guard_mon is not None:
+                # fold the group's diag stream into the detector (one
+                # tiny host transfer per step, at the group boundary —
+                # never ahead of the dispatch frontier)
+                rewind = False
+                for t, diag in self._drain_guard_diag():
+                    verdict = guard_mon.observe(
+                        t, float(diag[0]), float(diag[1]), float(diag[2]))
+                    rewind = rewind or verdict == "rewind"
+                # feed the warmed statistics back into the in-graph
+                # gate: a traced scalar operand, so no recompile
+                _trainer.guard_threshold = guard_mon.gate_threshold()
+                if rewind:
+                    raise _guard.GuardrailRewind(
+                        step=loop["gs"], epoch=epoch, nbatch=done,
+                        reason=guard_mon.last_reason)
             if ckpt_mgr is None:
                 return
             if preempt["flag"]:
@@ -633,13 +720,94 @@ class BaseModule(object):
                     pass  # not the main thread: periodic ckpts still work
 
         try:
-            self._fit_epochs(
-                fit_data, train_data, eval_data, eval_metric,
-                validation_metric, begin_epoch, num_epoch, monitor,
-                batch_end_callback, epoch_end_callback, eval_end_callback,
-                eval_batch_end_callback, fit_k, _queue_metric,
-                _drain_metrics, _after_steps, ckpt_mgr, loop, _capture,
-                resume_skip, resume_metric, auto_tuner)
+            while True:
+                try:
+                    self._fit_epochs(
+                        fit_data, train_data, eval_data, eval_metric,
+                        validation_metric, begin_epoch, num_epoch, monitor,
+                        batch_end_callback, epoch_end_callback,
+                        eval_end_callback, eval_batch_end_callback, fit_k,
+                        _queue_metric, _drain_metrics, _after_steps,
+                        ckpt_mgr, loop, _capture, resume_skip,
+                        resume_metric, auto_tuner)
+                    break
+                except _guard.GuardrailRewind as rw:
+                    # -- rewind-to-last-good (docs/robustness.md) ------
+                    # The dispatch frontier is at a group boundary (the
+                    # monitor only votes there); deferred metric
+                    # fetches are for steps about to be discarded.
+                    deferred_metrics.clear()
+                    _G_DISPATCH_DEPTH.set(0)
+                    self._drain_guard_diag()
+                    ckpt_mgr.wait()  # in-flight async save must land
+                    state = (ckpt_mgr.load_last_good()
+                             if guard_mon.rewinds < guard_mon.max_rewinds
+                             else None)
+                    if state is None:
+                        # budget exhausted (or nothing good on disk):
+                        # publish the structured verdict where the
+                        # watchdog looks and stop — replaying the same
+                        # data diverges the same way
+                        paths = _guard.write_verdict({
+                            "action": "abort",
+                            "reason": rw.reason,
+                            "step": rw.step,
+                            "epoch": rw.epoch,
+                            "nbatch": rw.nbatch,
+                            "rewinds": guard_mon.rewinds,
+                            "budget": guard_mon.max_rewinds,
+                            "last_clean_step": guard_mon.last_clean_step,
+                        }, extra_dir=ckpt_mgr.directory)
+                        self.logger.error(
+                            "guardrail: unrecoverable anomaly at step %d "
+                            "(%s) — rewind budget %d/%d spent, verdict "
+                            "at %s, exiting %d",
+                            rw.step, rw.reason, guard_mon.rewinds,
+                            guard_mon.max_rewinds, paths or "<nowhere>",
+                            _guard.EXIT_GUARDRAIL)
+                        raise SystemExit(_guard.EXIT_GUARDRAIL)
+                    _guard.count_rewind(guard_mon)
+                    if _fault.configured():
+                        # SIGKILL-during-rewind chain test hook: the
+                        # last-good target is chosen but nothing is
+                        # restored yet — a kill here must leave a
+                        # relaunch able to recover
+                        _fault.fire("rewind", step=rw.step)
+                    begin_epoch, resume_skip, gs0, resume_metric = \
+                        _restore_from_state(state)
+                    guard_mon.restore(state.get("health"))
+                    _trainer.guard_threshold = guard_mon.gate_threshold()
+                    if begin_epoch == rw.epoch:
+                        # steer past the poison window: everything up to
+                        # and including the batch that tripped the
+                        # detector is skipped via the O(1) sample
+                        # cursor, not retrained
+                        resume_skip = max(resume_skip, rw.nbatch)
+                    self.logger.warning(
+                        "guardrail: rewound to last-good step %d "
+                        "(epoch %d) after anomaly at step %d — "
+                        "re-entering at batch %d (%d/%d rewinds spent)",
+                        gs0, begin_epoch, rw.step, resume_skip,
+                        guard_mon.rewinds, guard_mon.max_rewinds)
+
+                    def _seek(inner):
+                        # reposition the source to the REWIND epoch:
+                        # seek_epoch keeps the epoch counter (and with
+                        # it the shuffle order) aligned; reset() is the
+                        # fallback for order-free iterators
+                        if hasattr(inner, "seek_epoch"):
+                            inner.seek_epoch(begin_epoch)
+                        else:
+                            inner.reset()
+
+                    if hasattr(fit_data, "rewind"):
+                        fit_data.rewind(_seek)
+                    else:
+                        _seek(fit_data)
+                    loop["gs"] = gs0
+                    loop["done"] = resume_skip
+                    loop["epoch"] = begin_epoch
+                    loop["last_saved"] = gs0
         finally:
             if fleet_hb is not None:
                 fleet_hb.stop()
@@ -650,6 +818,11 @@ class BaseModule(object):
                     pass
             if ckpt_mgr is not None:
                 ckpt_mgr.wait()
+
+    def _drain_guard_diag(self):
+        """Guardrail diag samples queued since the last drain (none for
+        the base/executor path — Module overrides on the fused path)."""
+        return []
 
     def _note_op_costs(self, train_data):
         """Emit the bound symbol's per-op analytic cost table into the
@@ -687,6 +860,8 @@ class BaseModule(object):
                     auto_tuner=None):
         """Epoch loop body of :meth:`fit` (split out so the signal-window
         try/finally in fit stays readable)."""
+        from ..resilience import fault as _fault
+
         _tm.anatomy.begin_loop()
         self._note_op_costs(train_data)
 
@@ -762,6 +937,14 @@ class BaseModule(object):
                         _after_steps(epoch, nbatch + 1, 1)
 
             for nbatch, data_batch in enumerate(fit_data, start=skip):
+                if _fault.configured():
+                    # poison-batch injection (nan_grad_at_step /
+                    # loss_spike_at_step): this batch will feed
+                    # optimizer step gs + len(pending) + 1
+                    _mode = _fault.batch_poison(
+                        loop["gs"] + len(pending) + 1)
+                    if _mode:
+                        data_batch = _poison_batch(data_batch, _mode)
                 use_multi = (
                     _k() > 1 and monitor is None
                     and getattr(self, "_fused_trainer", None) is not None

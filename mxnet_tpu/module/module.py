@@ -659,10 +659,17 @@ class Module(BaseModule):
                 )
                 _H_DISPATCH_HOST.observe(time.perf_counter() - t0)
             owner._fused_params, owner._fused_aux, owner._fused_opt = p, a, s
+            outs = list(outs)
+            if getattr(self._fused_trainer, "guard", False):
+                # last output head is the guardrail diag (loss, gnorm²,
+                # gate_ok): queue it for the fit-side monitor, keep it
+                # out of get_outputs()/metrics
+                owner._guard_pending = getattr(owner, "_guard_pending", [])
+                owner._guard_pending.append((owner._fused_t, outs.pop()))
             # raw jax.Arrays; _local_rows conversion (a host transfer in
             # multi-process runs) happens lazily on first read so loops
             # that never touch outputs don't stall the async pipeline
-            self._fused_outs_raw = list(outs)
+            self._fused_outs_raw = outs
             self._fused_outputs = None
             self._fused_batch = None
             owner._fused_exec_stale = True
@@ -780,6 +787,10 @@ class Module(BaseModule):
         self._fused_batch = None
         # outs: stacked (K, rows, ...) per head; slice lazily per step
         steps = [[o[i] for o in outs] for i in range(k)]
+        if getattr(trainer, "guard", False):
+            owner._guard_pending = getattr(owner, "_guard_pending", [])
+            for i in range(k):
+                owner._guard_pending.append((ts[i], steps[i].pop()))
         # leave the LAST step's outputs readable via get_outputs()
         self._install_step_outputs(steps[-1])
         return steps
@@ -791,6 +802,19 @@ class Module(BaseModule):
         ONLY sanctioned way for callers to set fused-output state)."""
         self._fused_outs_raw = outs_raw
         self._fused_outputs = None
+
+    def _drain_guard_diag(self):
+        """Return queued (step_t, diag) guardrail samples and clear the
+        queue.  diag is a length-3 float32 vector (loss, grad-norm²,
+        gate_ok); materialising it here is the only host sync the
+        guardrail adds, one tiny transfer per step group."""
+        owner = self._fused_owner or self
+        pending = getattr(owner, "_guard_pending", None)
+        if not pending:
+            return []
+        out = [(int(t), np.asarray(_local_rows(d))) for t, d in pending]
+        pending.clear()
+        return out
 
     def _materialized_fused_outputs(self):
         if self._fused_outputs is None and self._fused_outs_raw is not None:

@@ -273,6 +273,19 @@ class ShardedTrainStep:
         self.amp_scale_window = int(
             os.environ.get("MXTPU_LOSS_SCALE_WINDOW", "2000"))
         self.amp_scale_max = 2.0 ** 24
+        # -- training guardrails (resilience/guardrail.py) --------------
+        # guard=True makes the step (a) emit a (loss, grad_norm²,
+        # gate_ok) diag output head and (b) apply the AMP-style
+        # branchless select — generalized to fp32 — so a non-finite or
+        # out-of-threshold gradient updates NOTHING, bitwise.
+        # guard_threshold is the host-side grad-norm² bound the
+        # GuardrailMonitor refreshes at group boundaries; it rides into
+        # the compiled program as a traced scalar (no recompiles), inf
+        # means "gate on non-finite only" (detector warmup). fit() arms
+        # this AFTER construction (guardrails="auto"), re-jitting the
+        # already-lazy step wrappers.
+        self.guard = False
+        self.guard_threshold = float("inf")
 
     # ------------------------------------------------------------------
     def _spec_for(self, name):
@@ -1047,10 +1060,11 @@ class ShardedTrainStep:
         program = self.program
         do_mirror = _mirror_enabled()
         amp = self.amp
+        guard = self.guard
         amp_cast = set(self.data_names) if (amp and self.amp_cast_data) \
             else set()
 
-        def step(params, aux, opt_state, batch, rng, lr, t):
+        def step(params, aux, opt_state, batch, rng, lr, t, gthr):
             if amp_cast:
                 # bf16 activations from the first op: cast floating DATA
                 # feeds (never labels — loss heads compare against them
@@ -1077,7 +1091,14 @@ class ShardedTrainStep:
                 # backward, keep dot/conv residuals (executor._mirror_policy)
                 loss_fn = jax.checkpoint(loss_fn, policy=_mirror_policy)
 
-            grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
+            if guard:
+                # value_and_grad instead of grad: the diag head needs
+                # the loss VALUE; the gradient computation is identical.
+                (loss_val, (outs, new_aux)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+            else:
+                grads, (outs, new_aux) = jax.grad(
+                    loss_fn, has_aux=True)(params)
             if amp:
                 # Loss scaling rides the GRADIENT stream, not the loss
                 # value: every loss head here ignores its incoming
@@ -1127,6 +1148,45 @@ class ShardedTrainStep:
                         if (k in aux and hasattr(v, "dtype")
                             and v.dtype != aux[k].dtype) else v)
                     for k, v in new_aux.items()}
+            if guard:
+                # Global grad-norm² from the SAME gradient stream the
+                # optimizer just consumed — replicated already, so this
+                # adds local reductions but no new collective. AMP grads
+                # arrive pre-multiplied by the loss scale; unscale the
+                # squared norm so the gate threshold and the host
+                # detector both see true magnitudes.
+                gn2 = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in grads.values())
+                if amp:
+                    inv = 1.0 / opt_state[self.AMP_SCALE_KEY].astype(
+                        jnp.float32)
+                    gn2 = gn2 * inv * inv
+                ok = jnp.logical_and(jnp.isfinite(gn2), gn2 <= gthr)
+
+                def _sel(new, old):
+                    # branchless select over a (possibly nested) state
+                    # entry: select(True, new, old) is bitwise `new`, so
+                    # a clean step is untouched by the gate
+                    return jax.tree_util.tree_map(
+                        lambda n_, o_: jnp.where(ok, n_, o_), new, old)
+
+                # AMP's scaler bookkeeping stays LIVE through a skip:
+                # reverting the scale would undo the backoff that makes
+                # the next attempt finite (same contract as the inner
+                # AMP gate, which also exempts these two keys).
+                passthru = ({self.AMP_SCALE_KEY, self.AMP_GOOD_KEY}
+                            if amp else ())
+                new_params = {k: (_sel(v, params[k]) if k in params else v)
+                              for k, v in new_params.items()}
+                new_opt = {k: (v if (k in passthru or k not in opt_state)
+                               else _sel(v, opt_state[k]))
+                           for k, v in new_opt.items()}
+                new_aux = {k: (_sel(v, aux[k]) if k in aux else v)
+                           for k, v in new_aux.items()}
+                diag = jnp.stack([
+                    jnp.asarray(loss_val, jnp.float32), gn2,
+                    ok.astype(jnp.float32)])
+                outs = list(outs) + [diag]
             return new_params, new_aux, new_opt, outs
 
         return step
@@ -1148,6 +1208,18 @@ class ShardedTrainStep:
                 donation="params,aux,opt_state")
         except Exception:  # noqa: BLE001 — observer only
             pass
+        return self
+
+    def arm_guard(self):
+        """Turn the guardrail gate + diag head on (fit(guardrails=...)).
+
+        Re-wraps the step jits; jax.jit traces lazily, so arming before
+        the first dispatch costs nothing extra, and arming later in a
+        trainer's life retraces once at the next call. Idempotent."""
+        if not self.guard:
+            self.guard = True
+            self._step_multi.clear()
+            self.compile()
         return self
 
     def compile_multi(self, k):
@@ -1173,12 +1245,14 @@ class ShardedTrainStep:
             return fn
         step = self._make_step_fn()
 
-        def multi(params, aux, opt_state, batches, rngs, lrs, ts):
+        def multi(params, aux, opt_state, batches, rngs, lrs, ts, gthr):
             def body(carry, xs):
                 p, a, s = carry
                 batch_k, rng_k, lr_k, t_k = xs
+                # gthr is a loop constant: the monitor refreshes it at
+                # group boundaries, never inside a K-group
                 np_, na, ns, outs = step(p, a, s, batch_k, rng_k,
-                                         lr_k, t_k)
+                                         lr_k, t_k, gthr)
                 return (np_, na, ns), outs
 
             (p, a, s), outs = jax.lax.scan(
@@ -1225,6 +1299,7 @@ class ShardedTrainStep:
             rngs = jnp.zeros((k, 2), jnp.uint32)
         lrs_arr = jnp.asarray(lrs, jnp.float32)
         ts_arr = jnp.asarray(ts, jnp.float32)
+        gthr_arr = jnp.asarray(self.guard_threshold, jnp.float32)
         if _tm.anatomy.wants_cost():
             # AOT lower+compile BEFORE the donating dispatch (lower does
             # not consume buffers); cached per signature, so the steady
@@ -1235,12 +1310,12 @@ class ShardedTrainStep:
             _tm.anatomy.capture_cost(
                 self.program._program_uid, ("multi", k) + sig,
                 lambda: fn.lower(params, aux, opt_state, batches, rngs,
-                                 lrs_arr, ts_arr).compile(),
+                                 lrs_arr, ts_arr, gthr_arr).compile(),
                 dtype="bf16" if self.amp else "f32")
         _M_STEPS.inc(k, path="multi")
         with _tm.span("train_step.dispatch", k=k):
             return fn(params, aux, opt_state, batches, rngs,
-                      lrs_arr, ts_arr)
+                      lrs_arr, ts_arr, gthr_arr)
 
     def __call__(self, params, aux, opt_state, batch, rng=None, lr=None, t=1):
         assert self._step is not None, "call compile() first"
@@ -1283,13 +1358,15 @@ class ShardedTrainStep:
                 rng = jnp.zeros((2,), jnp.uint32)  # unused placeholder
         lr_arr = jnp.asarray(lr, jnp.float32)
         t_arr = jnp.asarray(t, jnp.float32)
+        gthr_arr = jnp.asarray(self.guard_threshold, jnp.float32)
         if _tm.anatomy.wants_cost():
             _tm.anatomy.capture_cost(
                 self.program._program_uid, ("single",) + sig,
                 lambda: self._step.lower(params, aux, opt_state, batch,
-                                         rng, lr_arr, t_arr).compile(),
+                                         rng, lr_arr, t_arr,
+                                         gthr_arr).compile(),
                 dtype="bf16" if self.amp else "f32")
         _M_STEPS.inc(path="single")
         with _tm.span("train_step.dispatch", t=t):
             return self._step(params, aux, opt_state, batch, rng,
-                              lr_arr, t_arr)
+                              lr_arr, t_arr, gthr_arr)

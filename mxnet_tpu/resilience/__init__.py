@@ -13,12 +13,19 @@ Four coordinated pieces (see docs/robustness.md):
 * :mod:`.retry` — jittered-exponential-backoff retries with transient
   error classification, shared by kvstore, recordio, and checkpoint I/O.
 
+* :mod:`.guardrail` — numeric guardrails: streaming anomaly detection
+  over loss/grad-norm, rewind-to-last-good (``fit(guardrails="auto")``),
+  and the :data:`EXIT_GUARDRAIL` verdict when the rewind budget runs out.
+
 :mod:`.fault` is the test-only injection switchboard driving the
 crash-resume integration suite (``MXTPU_FAULT_INJECT``).
 """
-from . import checkpoint, fault, retry  # noqa: F401
+from . import checkpoint, fault, guardrail, retry  # noqa: F401
 from .checkpoint import (  # noqa: F401
     EXIT_PREEMPTED, EXIT_RESHAPE, CheckpointError, CheckpointManager,
     atomic_file, list_checkpoints, load_state, verify_checkpoint,
+)
+from .guardrail import (  # noqa: F401
+    EXIT_GUARDRAIL, GuardrailMonitor, GuardrailRewind,
 )
 from .retry import TransientError, is_retryable  # noqa: F401

@@ -420,13 +420,28 @@ class CheckpointManager:
         # single integer — no batch replay, no decode.
         if state.get("sample_position") is not None:
             manifest["sample_position"] = int(state["sample_position"])
+        # Guardrail health stamp (resilience/guardrail.py): known-clean
+        # flag + detector state at snapshot time. Rides in the MANIFEST
+        # (not just the train pickle) so last_good()/ckpt_inspect can
+        # judge a checkpoint without deserializing its payload.
+        if state.get("health"):
+            manifest["health"] = state["health"]
         payload = json.dumps(manifest, indent=1, sort_keys=True).encode()
         _write_member(tmp, MANIFEST, payload)
         return sum(m["bytes"] for m in files.values()) + len(payload)
 
     def _retain(self):
         steps = list_checkpoints(self.directory)
-        for step in steps[:-self.keep] if len(steps) > self.keep else []:
+        evict = steps[:-self.keep] if len(steps) > self.keep else []
+        if evict:
+            # never evict the newest known-good snapshot: if every
+            # checkpoint inside the keep-window is health-stamped
+            # unclean, the rewind target lives in the evict range and
+            # must survive retention pressure
+            protected = self._newest_clean(steps)
+            if protected is not None and protected in evict:
+                evict = [s for s in evict if s != protected]
+        for step in evict:
             shutil.rmtree(step_dir(self.directory, step),
                           ignore_errors=True)
         # Sweep orphaned build dirs from crashed writers (not ours: a
@@ -442,7 +457,53 @@ class CheckpointManager:
                 shutil.rmtree(os.path.join(self.directory, name),
                               ignore_errors=True)
 
+    def _newest_clean(self, steps):
+        """Newest step whose MANIFEST health stamp says ``clean`` (None
+        when no checkpoint carries a stamp — unstamped runs have no
+        guardrail, so nothing needs protecting). Manifest-only: no
+        payload read, cheap enough for every retention pass."""
+        for step in reversed(steps):
+            try:
+                manifest = read_manifest(step_dir(self.directory, step))
+            except (OSError, ValueError):
+                continue
+            health = manifest.get("health")
+            if isinstance(health, dict) and health.get("clean"):
+                return step
+        return None
+
     # -- read side ------------------------------------------------------
+
+    def last_good(self, deep=False):
+        """Path of the newest checkpoint that verifies AND whose health
+        stamp is clean, or None. Stamped-unclean checkpoints are
+        skipped; an unstamped (pre-guardrail / guardrail-off) manifest
+        counts as good — absence of evidence is not an anomaly."""
+        for step in reversed(list_checkpoints(self.directory)):
+            path = step_dir(self.directory, step)
+            try:
+                manifest = read_manifest(path)
+            except (OSError, ValueError):
+                continue
+            health = manifest.get("health")
+            if isinstance(health, dict) and not health.get("clean"):
+                continue
+            try:
+                verify_checkpoint(path, deep=deep)
+                return path
+            except CheckpointError as exc:
+                if _C_SKIPPED:
+                    _C_SKIPPED.inc()
+                log.warning("skipping corrupt checkpoint %s: %s", path, exc)
+        return None
+
+    def load_last_good(self):
+        """Load the newest known-good checkpoint (rewind target), or
+        None when no healthy checkpoint exists."""
+        path = self.last_good()
+        if path is None:
+            return None
+        return load_state(path)
 
     def latest_valid(self, deep=False):
         """Newest checkpoint that verifies, or None. Torn/corrupt

@@ -47,6 +47,23 @@ Directives (value is always an integer):
                          (``stall_R`` tombstone + back-dated ``prog_R``):
                          the alive-but-wedged-in-a-collective signature
                          stalled_nodes()/--progress-timeout catch.
+``nan_grad_at_step=K``   Poison the batch feeding optimizer step K with
+                         NaNs (fit's ``batch_poison`` hook) — the
+                         gradient goes non-finite and the guardrail's
+                         in-graph finite gate must skip it bitwise.
+``loss_spike_at_step=K`` Scale the batch feeding step K by 1e4 — a
+                         finite but wildly out-of-distribution loss /
+                         grad-norm spike for the robust z detector.
+``bad_record=N``         The first N record decodes raise ValueError
+                         (``record_decode`` point) — drives the
+                         quarantine path in ``_decode_chunk_payloads``
+                         instead of the transport-level
+                         ``fail_recordio_read``.
+``kill_at_rewind=1``     SIGKILL this process inside fit's
+                         rewind-to-last-good handler, after the
+                         last-good checkpoint was chosen but before
+                         restore completes — the SIGKILL-during-rewind
+                         chain (a relaunch must still converge).
 =======================  ====================================================
 
 Values are integers except ``replica_lost``/``heartbeat_stall``, whose
@@ -121,7 +138,8 @@ def fire(point, **ctx):
 
     Points: ``step`` (ctx: step), ``ckpt_write`` (ctx: path),
     ``ckpt_done`` (ctx: path), ``collective``, ``recordio_read``
-    (ctx: uri, offset), ``kv_push`` / ``kv_pull`` (ctx: key).
+    (ctx: uri, offset), ``record_decode`` (ctx: uri, ordinal),
+    ``rewind`` (ctx: step), ``kv_push`` / ``kv_pull`` (ctx: key).
     """
     raw, spec = _spec()
     if not spec:
@@ -175,6 +193,15 @@ def fire(point, **ctx):
             # fit's elastic guard on the peers) takes it from there.
             while True:
                 time.sleep(60.0)
+    elif point == "rewind":
+        if spec.get("kill_at_rewind", 0) and _take(raw, "kill_at_rewind", 1):
+            os.kill(os.getpid(), signal.SIGKILL)
+    elif point == "record_decode":
+        n = spec.get("bad_record", 0)
+        if n and _take(raw, "bad_record", n):
+            raise ValueError(
+                "injected bad record: %s ordinal=%s"
+                % (ctx.get("uri"), ctx.get("ordinal")))
     elif point == "recordio_read":
         n = spec.get("fail_recordio_read", 0)
         if n and _take(raw, "fail_recordio_read", n):
@@ -188,6 +215,24 @@ def fire(point, **ctx):
         n = spec.get("fail_kv_pull", 0)
         if n and _take(raw, "fail_kv_pull", n):
             raise _transient("kv pull key=%s" % ctx.get("key"))
+
+
+def batch_poison(step):
+    """Poison verdict for the batch feeding optimizer step ``step``:
+    ``"nan"`` / ``"spike"`` / None. A separate entry point from
+    :func:`fire` because the injection must ALTER the batch (fit
+    rebuilds it poisoned), not raise or kill — each directive fires at
+    most once per process, like the other ``*_at_step`` budgets."""
+    raw, spec = _spec()
+    if not spec:
+        return None
+    if (spec.get("nan_grad_at_step") == step
+            and _take(raw, "nan_grad", 1)):
+        return "nan"
+    if (spec.get("loss_spike_at_step") == step
+            and _take(raw, "loss_spike", 1)):
+        return "spike"
+    return None
 
 
 _RUN_DIR_ENV = "MXTPU_RUN_DIR"

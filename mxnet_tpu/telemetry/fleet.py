@@ -180,6 +180,16 @@ def _iter_jsonl(path):
 # aggregation
 # ---------------------------------------------------------------------------
 
+def _snapshot_counter(metrics, name):
+    """Total of a counter metric in a raw registry snapshot (all label
+    streams summed), 0 when absent/malformed."""
+    try:
+        streams = (metrics or {}).get(name, {}).get("streams") or []
+        return sum(float(s.get("value") or 0.0) for s in streams)
+    except (TypeError, ValueError, AttributeError):
+        return 0.0
+
+
 class FleetAggregator:
     """Merge per-rank telemetry streams from one run dir into a single
     cross-rank view. :meth:`refresh` re-reads the files and is safe to
@@ -198,7 +208,7 @@ class FleetAggregator:
         self.ranks = {}
         for rank, path in sorted(discover(self.run_dir).items()):
             state = {"rank": rank, "path": path, "pid": None, "host": None,
-                     "anatomy": [], "recompiles": 0}
+                     "anatomy": [], "recompiles": 0, "metrics": None}
             offset = self.offsets.get(rank, {}).get("offset", 0.0)
             for rec in _iter_jsonl(path):
                 if state["pid"] is None and "pid" in rec:
@@ -214,6 +224,10 @@ class FleetAggregator:
                     self.registry.merge_snapshot(
                         rec.get("metrics", {}), rank=rank,
                         seq=rec.get("seq"))
+                    # last-wins raw snapshot: counters are cumulative,
+                    # so the newest record IS the rank's current total
+                    # (guardrail/bad-record flags read from here)
+                    state["metrics"] = rec.get("metrics")
                 elif typ == "recompile":
                     state["recompiles"] += 1
             self.ranks[rank] = state
@@ -356,6 +370,14 @@ class FleetAggregator:
                 "prog_age": live.get("prog_age"),
                 "lost": live.get("lost", False),
                 "stalled": live.get("stalled", False),
+                "guard_trips":
+                    _snapshot_counter(st["metrics"], "guard.trips"),
+                "guard_skips":
+                    _snapshot_counter(st["metrics"], "guard.skips"),
+                "guard_rewinds":
+                    _snapshot_counter(st["metrics"], "guard.rewinds"),
+                "bad_records":
+                    _snapshot_counter(st["metrics"], "io.bad_records"),
             }
         if max_intervals is not None:
             intervals = intervals[-max_intervals:]

@@ -36,6 +36,12 @@ def pytest_configure(config):
         "timeout(seconds): SIGALRM deadline for one test — guards the "
         "multi-process input-pipeline tests against a hung decode pool "
         "taking the whole tier-1 run down with it")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight multi-process / subprocess-relaunch / "
+        "SIGKILL-chain tests excluded from the tier-1 budget "
+        "(-m 'not slow'); the full suite runs them nightly — see "
+        "tests/README.md")
 
 
 @pytest.hookimpl(wrapper=True)

@@ -26,6 +26,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_two_process_launch(tmp_path):
     env = dict(os.environ)
     # the workers pick their own platform/device-count; drop the parent

@@ -13,6 +13,8 @@ import os
 import socket
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(ROOT, "tools"))
 
@@ -27,6 +29,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_dist_training_survives_worker_death(tmp_path):
     env_backup = os.environ.get("XLA_FLAGS")
     os.environ.pop("XLA_FLAGS", None)  # workers set their own

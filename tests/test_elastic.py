@@ -261,6 +261,7 @@ def _load_blob(path):
         return {k: z[k] for k in z.files}
 
 
+@pytest.mark.slow
 def test_cross_world_sigkill_resume_chain(tmp_path):
     """dp=8 SIGKILLed -> resume dp=4 -> SIGKILL -> finish dp=8: the
     chained run's final params/metric match the uninterrupted dp=8 run
@@ -341,6 +342,7 @@ def test_fit_elastic_guard_exits_reshape_on_lost_peer(
     assert hb.tombstoned(str(run_dir)) == {3}
 
 
+@pytest.mark.slow
 def test_watchdog_elastic_shrink_and_continue(tmp_path, monkeypatch):
     """The full no-human-in-the-loop flow: fit detects the tombstoned
     peer (replica_lost fault), checkpoints, exits 76; watchdog shrinks

@@ -1,10 +1,16 @@
 """Subprocess smoke of user-facing example flows that no unit test
-covers end to end. Kept tiny (short epochs) so the suite stays fast."""
+covers end to end. Kept tiny (short epochs), but each smoke is a fresh
+interpreter + jax init + XLA compile, so the whole module rides in the
+nightly `slow` tier (tests/README.md)."""
 import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
 
 
 def _run_example(script, *args, timeout=420):

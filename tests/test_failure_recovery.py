@@ -13,6 +13,8 @@ import sys
 import textwrap
 import time
 
+import pytest
+
 import numpy as np
 
 import mxnet_tpu as mx
@@ -114,6 +116,7 @@ TRAIN_SCRIPT = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_watchdog_restarts_crashed_training(tmp_path):
     script = tmp_path / "train.py"
     prefix = str(tmp_path / "ckpt")
@@ -130,6 +133,7 @@ def test_watchdog_restarts_crashed_training(tmp_path):
     assert any("restart 1/2" in m for m in logs), logs
 
 
+@pytest.mark.slow
 def test_watchdog_startup_deadline(tmp_path):
     """A rank wedged BEFORE its first heartbeat (e.g. stuck distributed
     init) must trip the startup deadline, not hang the watchdog."""
@@ -154,6 +158,7 @@ def test_watchdog_startup_deadline(tmp_path):
     assert rc == 0
 
 
+@pytest.mark.slow
 def test_watchdog_catches_wedged_collective(tmp_path):
     """The hang class liveness beats CANNOT catch: the process is alive
     (daemon thread keeps beating) but the main thread is wedged — e.g.
@@ -184,6 +189,7 @@ def test_watchdog_catches_wedged_collective(tmp_path):
     assert any("no training progress" in m for m in logs), logs
 
 
+@pytest.mark.slow
 def test_watchdog_kills_hung_job(tmp_path):
     """Hang detection: a worker that stops heartbeating gets killed and
     the job restarted — exit codes alone can never catch this."""

@@ -1,0 +1,599 @@
+"""Training guardrails (resilience/guardrail.py): anomaly detection,
+bitwise step skip, rewind-to-last-good, and poison-data quarantine.
+
+The contract under test, from docs/robustness.md "Training guardrails":
+
+* a guardrail-enabled run with zero anomalies is BITWISE identical to a
+  guardrail-off run (the detector is observation-only until it trips);
+* an injected loss spike / NaN batch is skipped (or rewound past) and
+  the run still converges to the uninjected final loss within rtol=1e-4
+  — provable on a convex model, where the minimum is unique;
+* undecodable records are counted, named in the quarantine JSONL, and
+  budgeted (``MXTPU_BAD_RECORD_BUDGET``);
+* rewind-budget exhaustion exits with the structured
+  ``{"type": "guardrail"}`` verdict the watchdog records.
+
+Fault-injection budgets are per-process and keyed by the RAW spec
+string, so every ``MXTPU_FAULT_INJECT`` value in this file is unique —
+reusing one would find its budget already spent.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io_pipeline, recordio, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import checkpoint as ck
+from mxnet_tpu.resilience import guardrail
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FOUR_DEV = [mx.cpu(i) for i in range(4)]
+
+
+@pytest.fixture(autouse=True)
+def _reap_pools():
+    yield
+    io_pipeline.shutdown_all()
+
+
+# ---------------------------------------------------------------------------
+# monitor unit behavior
+# ---------------------------------------------------------------------------
+
+def test_monitor_warmup_is_exempt_then_trips():
+    mon = guardrail.GuardrailMonitor(window=4, zmax=10.0, rewind_after=3)
+    # warmup: even a wild value passes while the window fills
+    assert mon.observe(1, 1000.0, 1.0, 1.0) == "ok"
+    for step in range(2, 6):
+        assert mon.observe(step, 1.0, 1.0, 1.0) == "ok"
+    assert mon.loss.warm
+    # warm: a >10-sigma excursion trips and answers "skip"
+    assert mon.observe(6, 1e6, 1.0, 1.0) == "skip"
+    assert mon.trips == 1 and mon.consecutive == 1
+    # the anomalous value must NOT drag the baseline
+    assert mon.loss.med < 1000.0
+    # a clean step resets the consecutive ladder
+    assert mon.observe(7, 1.0, 1.0, 1.0) == "ok"
+    assert mon.consecutive == 0 and mon.last_clean_step == 7
+
+
+def test_monitor_nonfinite_trips_even_during_warmup():
+    mon = guardrail.GuardrailMonitor(window=64, rewind_after=2)
+    assert mon.observe(1, float("nan"), 1.0, 1.0) == "skip"
+    assert mon.observe(2, 1.0, float("inf"), 1.0) == "rewind"
+    assert mon.trips == 2 and mon.consecutive == 2
+
+
+def test_monitor_gate_skip_counts_and_escalates():
+    mon = guardrail.GuardrailMonitor(window=64, rewind_after=3)
+    # gate_ok=0.0: the in-graph select already skipped the update
+    assert mon.observe(1, 1.0, 1e30, 0.0) == "skip"
+    assert mon.observe(2, 1.0, 1e30, 0.0) == "skip"
+    assert mon.observe(3, 1.0, 1e30, 0.0) == "rewind"
+    assert mon.skips == 3 and mon.trips == 3
+
+
+def test_monitor_gate_threshold_inf_until_warm():
+    mon = guardrail.GuardrailMonitor(window=3, zmax=10.0)
+    assert mon.gate_threshold() == float("inf")
+    for step in range(1, 4):
+        mon.observe(step, 1.0, 2.0, 1.0)
+    thr = mon.gate_threshold()
+    assert np.isfinite(thr)
+    # the threshold bounds grad-norm SQUARED, above the observed 2.0
+    assert thr > 4.0
+
+
+def test_monitor_health_blob_restore_roundtrip():
+    mon = guardrail.GuardrailMonitor(window=4, rewind_after=2)
+    for step in range(1, 6):
+        mon.observe(step, float(step % 3), 1.0 + 0.1 * step, 1.0)
+    mon.observe(6, float("nan"), 1.0, 1.0)
+    blob = mon.health_blob(6)
+    assert blob["clean"] is False and blob["last_clean_step"] == 5
+    fresh = guardrail.GuardrailMonitor(window=4, rewind_after=2)
+    fresh.restore(blob)
+    assert fresh.loss.med == mon.loss.med
+    assert list(fresh.gnorm.buf) == list(mon.gnorm.buf)
+    assert fresh.last_clean_step == 5
+    # restore() survives garbage (pre-guardrail checkpoints)
+    guardrail.GuardrailMonitor().restore(None)
+    guardrail.GuardrailMonitor().restore({"bogus": 1})
+
+
+# ---------------------------------------------------------------------------
+# fit() end-to-end: bitwise parity, skip, rewind, verdict
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _linear():
+    """Convex (linear softmax) — cross-entropy then has a unique
+    minimum, so any recovered trajectory must land on the SAME final
+    loss, which is what makes rtol=1e-4 provable rather than lucky."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data():
+    rng = np.random.RandomState(42)
+    return (rng.randn(64, 8).astype(np.float32),
+            rng.randint(0, 4, 64).astype(np.float32))
+
+
+def _blob_iter():
+    x, y = _data()
+    return mx.io.NDArrayIter(x, y, batch_size=8)
+
+
+def _fit(ckpt_dir, sym=None, guardrails=None, num_epoch=60, resume=None):
+    np.random.seed(0)
+    mx.random.seed(0)
+    mod = mx.mod.Module(sym or _linear(), context=FOUR_DEV)
+    mod.fit(_blob_iter(), eval_metric=mx.metric.create("acc"),
+            kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Uniform(0.1), num_epoch=num_epoch,
+            checkpoint_dir=ckpt_dir, resume=resume, guardrails=guardrails)
+    assert mod._fused_trainer is not None
+    return mod
+
+
+def _params_of(mod):
+    arg, aux = mod.get_params()
+    out = {k: np.asarray(v.asnumpy()) for k, v in arg.items()}
+    out.update({"aux:" + k: np.asarray(v.asnumpy())
+                for k, v in aux.items()})
+    return out
+
+
+def _final_loss(mod):
+    x, y = _data()
+    probs = mod.predict(_blob_iter()).asnumpy()
+    return float(-np.mean(np.log(
+        probs[np.arange(len(y)), y.astype(int)] + 1e-12)))
+
+
+@pytest.fixture()
+def _guard_env(monkeypatch):
+    """Small detector window (warm by step 4 of an 8-step epoch) and a
+    clean fault/guard env slate."""
+    for var in ("MXTPU_FAULT_INJECT", "MXTPU_GUARD_REWIND_AFTER",
+                "MXTPU_GUARD_MAX_REWINDS", "MXTPU_RUN_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MXTPU_GUARD_WINDOW", "3")
+    monkeypatch.setenv(ck.ENV_INTERVAL, "4")
+    return monkeypatch
+
+
+def test_zero_anomaly_guard_run_is_bitwise_identical(tmp_path, _guard_env):
+    ref = _fit(str(tmp_path / "ref"), sym=_mlp(), num_epoch=2)
+    guarded = _fit(str(tmp_path / "g"), sym=_mlp(), guardrails="auto",
+                   num_epoch=2)
+    rp, gp = _params_of(ref), _params_of(guarded)
+    assert set(rp) == set(gp)
+    for k in rp:
+        np.testing.assert_array_equal(rp[k], gp[k], err_msg=k)
+
+
+def test_loss_spike_is_skipped_and_run_converges(
+        tmp_path, _guard_env, caplog):
+    ref_loss = _final_loss(_fit(str(tmp_path / "ref")))
+    _guard_env.setenv("MXTPU_FAULT_INJECT", "loss_spike_at_step=6")
+    with caplog.at_level("WARNING"):
+        mod = _fit(str(tmp_path / "spike"), guardrails="auto")
+    assert any("skipped step 6" in r.message for r in caplog.records), \
+        [r.message for r in caplog.records]
+    np.testing.assert_allclose(_final_loss(mod), ref_loss, rtol=1e-4)
+
+
+def test_nan_grad_is_skipped_and_run_converges(tmp_path, _guard_env):
+    # AMP off: the generalized fp32 finite-select, not AMP's scaler gate
+    assert os.environ.get("MXTPU_AMP") is None
+    ref_loss = _final_loss(_fit(str(tmp_path / "ref")))
+    _guard_env.setenv("MXTPU_FAULT_INJECT", "nan_grad_at_step=7")
+    mod = _fit(str(tmp_path / "nan"), guardrails="auto")
+    final = _params_of(mod)
+    for k, v in final.items():
+        assert np.isfinite(v).all(), k
+    np.testing.assert_allclose(_final_loss(mod), ref_loss, rtol=1e-4)
+
+
+def test_rewind_to_last_good_and_converge(tmp_path, _guard_env, caplog):
+    ref_loss = _final_loss(_fit(str(tmp_path / "ref")))
+    _guard_env.setenv("MXTPU_FAULT_INJECT", "nan_grad_at_step=11")
+    _guard_env.setenv("MXTPU_GUARD_REWIND_AFTER", "1")
+    with caplog.at_level("WARNING"):
+        mod = _fit(str(tmp_path / "rw"), guardrails="auto")
+    assert any("rewound to last-good step 8" in r.message
+               for r in caplog.records), \
+        [r.message for r in caplog.records]
+    np.testing.assert_allclose(_final_loss(mod), ref_loss, rtol=1e-4)
+
+
+def test_rewind_budget_exhaustion_exits_with_verdict(tmp_path, _guard_env):
+    ckpt = str(tmp_path / "ck")
+    _guard_env.setenv("MXTPU_FAULT_INJECT", "nan_grad_at_step=13")
+    _guard_env.setenv("MXTPU_GUARD_REWIND_AFTER", "1")
+    _guard_env.setenv("MXTPU_GUARD_MAX_REWINDS", "0")
+    with pytest.raises(SystemExit) as exc:
+        _fit(ckpt, guardrails="auto")
+    assert exc.value.code == resilience.EXIT_GUARDRAIL == 78
+    verdict_path = os.path.join(ckpt, guardrail.VERDICT_FILE)
+    assert os.path.exists(verdict_path)
+    verdict = json.load(open(verdict_path))
+    assert verdict["type"] == "guardrail"
+    assert verdict["action"] == "abort" and verdict["budget"] == 0
+    assert verdict["step"] == 13
+
+
+def test_watchdog_records_guardrail_verdict_and_stops(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import watchdog as wd
+
+    # exit 78 is terminal regardless of restart budget or elastic mode
+    assert wd.decide(wd.EXIT_GUARDRAIL, [], 0, 5, 8, False) == ("fail", 8)
+    assert wd.decide(wd.EXIT_GUARDRAIL, [3], 0, 5, 8, True) == ("fail", 8)
+    run_dir = str(tmp_path)
+    with open(os.path.join(run_dir, wd.GUARDRAIL_VERDICT_FILE), "w") as f:
+        json.dump({"type": "guardrail", "reason": "nan", "step": 9}, f)
+    wd._record_guardrail(run_dir, wd.EXIT_GUARDRAIL)
+    rows = [json.loads(ln) for ln in
+            open(os.path.join(run_dir, "decisions.jsonl"))]
+    assert rows[0]["type"] == "guardrail" and rows[0]["rc"] == 78
+    assert rows[0]["reason"] == "nan" and rows[0]["step"] == 9
+
+
+_CHAIN_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %(repo)r)
+    import numpy as np
+    import mxnet_tpu as mx
+
+    def linear():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def blob():
+        rng = np.random.RandomState(42)
+        return mx.io.NDArrayIter(rng.randn(64, 8).astype(np.float32),
+                                 rng.randint(0, 4, 64).astype(np.float32),
+                                 batch_size=8)
+
+    np.random.seed(0); mx.random.seed(0)
+    mod = mx.mod.Module(linear(), context=[mx.cpu(i) for i in range(4)])
+    mod.fit(blob(), eval_metric=mx.metric.create("acc"), kvstore="device",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Uniform(0.1), num_epoch=60,
+            checkpoint_dir=sys.argv[1], resume=sys.argv[2] or None,
+            guardrails="auto")
+    rng = np.random.RandomState(42)
+    rng.randn(64, 8)
+    labels = rng.randint(0, 4, 64)
+    probs = mod.predict(blob()).asnumpy()
+    loss = float(-np.mean(np.log(
+        probs[np.arange(64), labels] + 1e-12)))
+    print("FINAL_LOSS %%.9f" %% loss)
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_sigkill_during_rewind_chain_still_converges(tmp_path):
+    """The compound failure: an anomaly votes rewind, and the process is
+    SIGKILLed inside the rewind handler. The relaunch (resume="auto"
+    under guardrails) must restart from the last HEALTHY checkpoint and
+    still converge to the clean-run loss."""
+    script = str(tmp_path / "chain_job.py")
+    with open(script, "w") as f:
+        f.write(_CHAIN_SCRIPT % {"repo": REPO})
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               MXTPU_GUARD_WINDOW="3", MXTPU_GUARD_REWIND_AFTER="1")
+    env.pop("MXTPU_FAULT_INJECT", None)
+    env[ck.ENV_INTERVAL] = "4"
+
+    ref = subprocess.run(
+        [sys.executable, script, str(tmp_path / "ref"), ""],
+        capture_output=True, text=True, env=env, timeout=280)
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_loss = float(ref.stdout.split("FINAL_LOSS")[1].split()[0])
+
+    crash_env = dict(env,
+                     MXTPU_FAULT_INJECT="nan_grad_at_step=11,"
+                                        "kill_at_rewind=1")
+    ckpt = str(tmp_path / "chain")
+    crash = subprocess.run([sys.executable, script, ckpt, ""],
+                           capture_output=True, text=True, env=crash_env,
+                           timeout=280)
+    assert crash.returncode == -9, (crash.returncode, crash.stderr[-2000:])
+    # the kill landed mid-rewind: checkpoints exist, none past the trip
+    assert ck.list_checkpoints(ckpt), "no checkpoint before the kill"
+
+    resumed = subprocess.run([sys.executable, script, ckpt, "auto"],
+                             capture_output=True, text=True, env=env,
+                             timeout=280)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    res_loss = float(resumed.stdout.split("FINAL_LOSS")[1].split()[0])
+    np.testing.assert_allclose(res_loss, ref_loss, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint health stamp + retention
+# ---------------------------------------------------------------------------
+
+def _state(step, clean=None):
+    state = {
+        "module": {
+            "arg": {"w": np.full((2, 2), float(step), dtype=np.float32)},
+            "aux": {}, "opt": {"kind": "none"},
+        },
+        "epoch": 0, "nbatch": 0, "global_step": step,
+        "metric": None, "rng": {},
+    }
+    if clean is not None:
+        state["health"] = {"clean": clean, "step": step,
+                           "last_clean_step": step if clean else step - 1,
+                           "trips": 0 if clean else 1, "skips": 0}
+    return state
+
+
+def test_retention_never_evicts_newest_known_good(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path), keep=2)
+    mgr.save(_state(10, clean=True), 10)
+    mgr.save(_state(20, clean=False), 20)
+    mgr.save(_state(30, clean=False), 30)
+    mgr.save(_state(40, clean=False), 40)
+    steps = ck.list_checkpoints(str(tmp_path))
+    # keep=2 would leave {30, 40}; the guardrail pin protects 10, the
+    # newest known-good, because it is the only rewind target left
+    assert 10 in steps, steps
+    assert 20 not in steps, steps
+    assert mgr.last_good() == ck.step_dir(str(tmp_path), 10)
+    assert mgr.load_last_good()["global_step"] == 10
+    # a newer clean snapshot takes over the pin; the old one may age out
+    mgr.save(_state(50, clean=True), 50)
+    mgr.save(_state(60, clean=False), 60)
+    steps = ck.list_checkpoints(str(tmp_path))
+    assert mgr.last_good() == ck.step_dir(str(tmp_path), 50)
+    assert 10 not in steps, steps
+
+
+def test_last_good_skips_unclean_and_unstamped_counts_as_good(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path), keep=5)
+    mgr.save(_state(10), 10)             # unstamped (guardrails off)
+    mgr.save(_state(20, clean=False), 20)
+    assert mgr.last_good() == ck.step_dir(str(tmp_path), 10)
+    assert mgr.load_last_good()["global_step"] == 10
+    # nothing healthy at all -> None (fit falls through to the verdict)
+    empty = ck.CheckpointManager(str(tmp_path / "empty"))
+    assert empty.last_good() is None and empty.load_last_good() is None
+
+
+# ---------------------------------------------------------------------------
+# poison-data quarantine (io_pipeline)
+# ---------------------------------------------------------------------------
+
+SIZE = 32
+SHAPE = (3, SIZE, SIZE)
+
+
+def _pack(tmp_path, n, name="data"):
+    rng = np.random.RandomState(7)
+    rec = str(tmp_path / ("%s.rec" % name))
+    idx = str(tmp_path / ("%s.idx" % name))
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (SIZE, SIZE, 3)).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    w.close()
+    return rec
+
+
+def _drain(it):
+    n = 0
+    while True:
+        try:
+            it.next()
+        except StopIteration:
+            return n
+        n += 1
+
+
+def test_bad_record_quarantine_counts_and_names_ordinals(
+        tmp_path, monkeypatch):
+    rec = _pack(tmp_path, 24)
+    qfile = str(tmp_path / "quarantine.jsonl")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "bad_record=3")
+    monkeypatch.setenv(io_pipeline.ENV_QUARANTINE_FILE, qfile)
+    it = io_pipeline.StreamingImageRecordIter(
+        4, SHAPE, rec, shuffle=False, workers=0)
+    _drain(it)
+    assert it.bad_records == 3
+    rows = [json.loads(ln) for ln in open(qfile)]
+    assert len(rows) == 3
+    assert sorted(r["ordinal"] for r in rows) == [0, 1, 2]
+    for r in rows:
+        assert r["type"] == "quarantine" and r["uri"] == rec
+        assert r["chunk"] is not None
+        assert "injected bad record" in r["reason"]
+
+
+def test_bad_record_budget_exhaustion_raises(tmp_path, monkeypatch):
+    rec = _pack(tmp_path, 24)
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "bad_record=4")
+    monkeypatch.setenv(io_pipeline.ENV_BAD_RECORD_BUDGET, "1")
+    monkeypatch.delenv(io_pipeline.ENV_QUARANTINE_FILE, raising=False)
+    it = io_pipeline.StreamingImageRecordIter(
+        4, SHAPE, rec, shuffle=False, workers=0)
+    with pytest.raises(MXNetError, match="MXTPU_BAD_RECORD_BUDGET"):
+        _drain(it)
+
+
+def test_quarantine_survives_undecodable_bytes_without_fault_env(
+        tmp_path, monkeypatch):
+    """Real corruption (not injection): garbage image payload in the
+    middle of a .rec — the batch still comes up, the record is
+    quarantined by ordinal."""
+    monkeypatch.delenv("MXTPU_FAULT_INJECT", raising=False)
+    rng = np.random.RandomState(7)
+    rec = str(tmp_path / "mixed.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(8):
+        if i == 5:
+            w.write(recordio.pack(
+                recordio.IRHeader(0, float(i), i, 0), b"not-an-image"))
+        else:
+            img = rng.randint(0, 255, (SIZE, SIZE, 3)).astype(np.uint8)
+            w.write(recordio.pack_img(
+                recordio.IRHeader(0, float(i), i, 0), img))
+    w.close()
+    qfile = str(tmp_path / "q.jsonl")
+    monkeypatch.setenv(io_pipeline.ENV_QUARANTINE_FILE, qfile)
+    it = io_pipeline.StreamingImageRecordIter(
+        4, SHAPE, rec, shuffle=False, workers=0)
+    _drain(it)
+    assert it.bad_records == 1
+    rows = [json.loads(ln) for ln in open(qfile)]
+    assert len(rows) == 1 and rows[0]["ordinal"] == 5
+
+
+# ---------------------------------------------------------------------------
+# decode-pool worker death: resubmit-once
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_decode_pool_resubmits_dead_workers_chunks(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_INJECT", raising=False)
+    # tiny chunks (~1-2 records each): many chunks stay in flight, so
+    # the killed worker is holding work when it dies
+    monkeypatch.setenv(io_pipeline.ENV_CHUNK_BYTES, "2048")
+    rec = _pack(tmp_path, 48)
+    kw = dict(batch_size=4, data_shape=SHAPE, path_imgrec=rec,
+              shuffle=False, strict_order=True)
+    ref = []
+    it = io_pipeline.StreamingImageRecordIter(workers=0, **kw)
+    while True:
+        try:
+            b = it.next()
+        except StopIteration:
+            break
+        ref.append(np.asarray(b.label[0].asnumpy()))
+
+    it = io_pipeline.StreamingImageRecordIter(workers=2, **kw)
+    got = [np.asarray(it.next().label[0].asnumpy())]
+    # one worker dies mid-epoch with chunks in flight; the survivor
+    # absorbs the resubmitted backlog and the epoch completes intact
+    it._pool._procs[0].kill()
+    it._pool._procs[0].join()
+    while True:
+        try:
+            b = it.next()
+        except StopIteration:
+            break
+        got.append(np.asarray(b.label[0].asnumpy()))
+    assert len(got) == len(ref)
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(a, b, err_msg="batch %d" % i)
+
+
+@pytest.mark.timeout(120)
+def test_decode_pool_all_workers_dead_still_errors(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_INJECT", raising=False)
+    monkeypatch.setenv(io_pipeline.ENV_CHUNK_BYTES, "2048")
+    rec = _pack(tmp_path, 48)
+    it = io_pipeline.StreamingImageRecordIter(
+        4, SHAPE, rec, shuffle=False, workers=2, strict_order=True)
+    it.next()
+    for p in it._pool._procs:
+        p.kill()
+        p.join()
+    with pytest.raises(MXNetError, match="decode workers exited"):
+        _drain(it)
+
+
+# ---------------------------------------------------------------------------
+# seek_epoch: the rewind cursor
+# ---------------------------------------------------------------------------
+
+def test_seek_epoch_replays_shuffle_order_exactly(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_INJECT", raising=False)
+    rec = _pack(tmp_path, 36)
+    it = io_pipeline.StreamingImageRecordIter(
+        4, SHAPE, rec, workers=0, shuffle=True, seed=11,
+        shuffle_buffer=12, strict_order=True)
+
+    def labels_of_epoch():
+        out = []
+        while True:
+            try:
+                b = it.next()
+            except StopIteration:
+                return out
+            out.append(np.asarray(b.label[0].asnumpy()))
+
+    epoch0 = labels_of_epoch()
+    it.reset()
+    epoch1 = labels_of_epoch()
+    it.reset()
+    epoch2 = labels_of_epoch()
+    assert [a.tolist() for a in epoch0] != [a.tolist() for a in epoch1]
+    # rewind into the MIDDLE of history: epoch 1 must replay its own
+    # shuffle order, not epoch 3's — that is what distinguishes
+    # seek_epoch (epoch is SET) from reset() (epoch increments)
+    it.seek_epoch(1)
+    replay1 = labels_of_epoch()
+    assert len(epoch1) == len(replay1)
+    for a, b in zip(epoch1, replay1):
+        np.testing.assert_array_equal(a, b)
+    # and the pass after the replayed one is epoch 2's order again
+    it.reset()
+    replay2 = labels_of_epoch()
+    for a, b in zip(epoch2, replay2):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# MXRecordIO mid-file corruption context
+# ---------------------------------------------------------------------------
+
+def test_recordio_midfile_corrupt_magic_names_uri_and_offset(tmp_path):
+    rec = str(tmp_path / "corrupt.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(4):
+        w.write(b"payload-%d" % i)
+    w.close()
+    offsets = recordio.scan_record_offsets(rec)
+    assert len(offsets) == 4
+    # flip the MAGIC of record 2: records 0-1 must still read, record 2
+    # must fail with the uri and the exact byte offset in the message
+    with open(rec, "r+b") as f:
+        f.seek(offsets[2])
+        f.write(b"\x00\x00\x00\x00")
+    r = recordio.MXRecordIO(rec, "r")
+    assert r.read() == b"payload-0"
+    assert r.read() == b"payload-1"
+    with pytest.raises(MXNetError) as exc:
+        r.read()
+    msg = str(exc.value)
+    assert rec in msg, msg
+    assert "offset %d" % offsets[2] in msg, msg
+    assert "magic" in msg, msg

@@ -213,6 +213,7 @@ def test_seek_sample_absolute_and_rewind(tmp_path):
     _assert_batches_equal(_collect(it, 1), [ref[1]])
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(300)
 def test_sigkill_resume_repositions_bitwise(tmp_path):
     """Crash-exact resume on a sharded iterator: a child consumes two

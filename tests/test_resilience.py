@@ -565,6 +565,7 @@ def _assert_blob_equal(got, want):
             got[key], want[key], err_msg="%s differs after resume" % key)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("fit_k,device_feed", [("1", "1"), ("2", "0")])
 def test_sigkill_crash_resume_bitwise_parity(tmp_path, fit_k, device_feed):
     base_env = {

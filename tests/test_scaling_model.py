@@ -78,7 +78,8 @@ def _dp_step_allreduce_check(ShardedTrainStep, make_mesh):
     step.compile()
     hlo = step._step.lower(
         params, aux, opt_state, batch, jnp.zeros((2,), jnp.uint32),
-        jnp.asarray(0.1, jnp.float32), jnp.asarray(1.0, jnp.float32)
+        jnp.asarray(0.1, jnp.float32), jnp.asarray(1.0, jnp.float32),
+        jnp.asarray(jnp.inf, jnp.float32)  # guard gate open
     ).compile().as_text()
     sizes, _ = hlo_allreduce_bytes(hlo)
     param_bytes = sum(int(np.prod(v.shape)) * 4 for v in host.values())

@@ -48,6 +48,19 @@ def _topology_str(manifest):
         topo.get("per_replica_batch"), topo.get("mesh"))
 
 
+def _health_str(manifest):
+    """Render the guardrail ``health`` stamp: clean/ANOMALOUS, the last
+    step the detector saw as clean, and the trip/skip tallies. None for
+    unstamped (guardrail-off) checkpoints."""
+    health = manifest.get("health")
+    if not isinstance(health, dict):
+        return None
+    return "%s last_clean=%s trips=%s skips=%s" % (
+        "clean" if health.get("clean") else "ANOMALOUS",
+        health.get("last_clean_step"), health.get("trips"),
+        health.get("skips"))
+
+
 def topology_warnings(manifest, expect_dp=None, expect_batch=None):
     """Cross-world restore preflight: WARNINGS (never failures — the
     state format is layout-independent, so a dp/batch mismatch means an
@@ -86,10 +99,12 @@ def list_dir(directory, deep=False, expect_dp=None, expect_batch=None):
             manifest = ck.verify_checkpoint(path, deep=deep)
             n_tensors = len(manifest.get("tensors", {}))
             topo = _topology_str(manifest)
-            lines.append("ckpt-%012d  %9d bytes  %3d tensors  OK%s%s"
+            health = _health_str(manifest)
+            lines.append("ckpt-%012d  %9d bytes  %3d tensors  OK%s%s%s"
                          % (step, _dir_bytes(path), n_tensors,
                             " (deep)" if deep else "",
-                            "  [%s]" % topo if topo else ""))
+                            "  [%s]" % topo if topo else "",
+                            "  [health: %s]" % health if health else ""))
             for warning in topology_warnings(
                     manifest, expect_dp, expect_batch):
                 lines.append("  %s" % warning)
@@ -97,6 +112,16 @@ def list_dir(directory, deep=False, expect_dp=None, expect_batch=None):
             bad += 1
             lines.append("ckpt-%012d  CORRUPT: %s" % (step, exc))
     return lines, bad
+
+
+def last_good(directory):
+    """Path of the newest healthy checkpoint (verifies AND health stamp
+    is clean or absent) — the guardrail rewind target. Raises
+    SystemExit when nothing qualifies so the shell sees exit 1."""
+    path = ck.CheckpointManager(directory).last_good()
+    if path is None:
+        raise SystemExit("no known-good checkpoint under %s" % directory)
+    return path
 
 
 def state_summary(directory, which):
@@ -127,6 +152,8 @@ def state_summary(directory, which):
             (train.get("rng") or {}).keys())),
         "topology   : %s" % (_topology_str(manifest)
                              or "not recorded (pre-elastic checkpoint)"),
+        "health     : %s" % (_health_str(manifest)
+                             or "not stamped (guardrails off)"),
         "tensors    :",
     ]
     from mxnet_tpu import ndarray as nd
@@ -188,6 +215,29 @@ def _self_test():
     assert any("CORRUPT" in ln for ln in lines), lines
     text = state_summary(d, "latest")
     assert "ckpt-%012d" % 10 in text, text
+    # unstamped checkpoints: summary says so, --last-good still finds
+    # the newest VALID one (absence of a stamp is not an anomaly)
+    assert "not stamped (guardrails off)" in text, text
+    assert last_good(d) == ck.step_dir(d, 10), last_good(d)
+
+    # guardrail health stamps: clean shows in the listing; an
+    # ANOMALOUS newest checkpoint is skipped by --last-good
+    state_clean = dict(state)
+    state_clean["health"] = {"clean": True, "step": 30,
+                             "last_clean_step": 30, "trips": 0,
+                             "skips": 0}
+    mgr.save(state_clean, 30)
+    state_bad = dict(state)
+    state_bad["health"] = {"clean": False, "step": 40,
+                           "last_clean_step": 30, "trips": 3, "skips": 2}
+    mgr.save(state_bad, 40)
+    lines, _ = list_dir(d)
+    assert any("health: clean last_clean=30" in ln for ln in lines), lines
+    assert any("health: ANOMALOUS last_clean=30 trips=3 skips=2" in ln
+               for ln in lines), lines
+    text = state_summary(d, "latest")
+    assert "health     : ANOMALOUS" in text, text
+    assert last_good(d) == ck.step_dir(d, 30), last_good(d)
     print("self-test passed")
     return 0
 
@@ -203,6 +253,11 @@ def main(argv=None):
     parser.add_argument("--state", metavar="STEP",
                         help="print the training-state summary of one "
                              "checkpoint ('latest' or a step number)")
+    parser.add_argument("--last-good", action="store_true",
+                        help="print the path of the newest HEALTHY "
+                             "checkpoint (verifies, and its guardrail "
+                             "health stamp — when present — says clean); "
+                             "exit 1 when none qualifies")
     parser.add_argument("--self-test", action="store_true",
                         help="run built-in checks on synthetic checkpoints")
     parser.add_argument("--expect-dp", type=int, default=None,
@@ -217,6 +272,9 @@ def main(argv=None):
         return _self_test()
     if not args.directory:
         parser.error("directory required (or --self-test)")
+    if args.last_good:
+        print(last_good(args.directory))
+        return 0
     if args.state:
         print(state_summary(args.directory, args.state))
         return 0

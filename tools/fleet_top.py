@@ -160,6 +160,12 @@ def render_table(summary):
             flags.append("STALL")
         if summary.get("straggler") == rank:
             flags.append("STRAGGLER")
+        if pr.get("guard_rewinds"):
+            flags.append("REWOUND×%d" % int(pr["guard_rewinds"]))
+        elif pr.get("guard_trips") or pr.get("guard_skips"):
+            flags.append("GUARD")
+        if pr.get("bad_records"):
+            flags.append("BADREC×%d" % int(pr["bad_records"]))
         lines.append(
             "%4d  %5s  %6s  %7s  %6s  %7s  %7s  %6s  %8s  %s" % (
                 rank,
@@ -194,9 +200,11 @@ def _default_interval():
 # self-test
 # ---------------------------------------------------------------------------
 
-def _write_rank(run_dir, rank, intervals, slow_phase=None, slow=0.0):
+def _write_rank(run_dir, rank, intervals, slow_phase=None, slow=0.0,
+                extra_metrics=None):
     """Synthesize one rank's telemetry stream: anatomy intervals with an
-    exact phase/wall invariant, plus a seq'd metrics snapshot."""
+    exact phase/wall invariant, plus a seq'd metrics snapshot
+    (``extra_metrics`` merges additional counters into the snapshot)."""
     path = os.path.join(run_dir, "telemetry_r%d.jsonl" % rank)
     now = time.time()
     with open(path, "w") as f:
@@ -217,6 +225,7 @@ def _write_rank(run_dir, rank, intervals, slow_phase=None, slow=0.0):
             f.write(json.dumps(rec) + "\n")
         snap = {"fit.steps": {"kind": "counter", "streams": [
             {"labels": {}, "value": intervals * 4}]}}
+        snap.update(extra_metrics or {})
         f.write(json.dumps({"type": "metrics", "ts": now, "seq": 1,
                             "rank": rank, "pid": 1000 + rank,
                             "host": "host%d" % rank,
@@ -232,10 +241,19 @@ def _self_test():
     tmp = tempfile.mkdtemp(prefix="mxtpu_fleet_top_")
     try:
         # -- straggler table over a synthetic 3-rank run ----------------
+        guard_snap = {
+            "guard.trips": {"kind": "counter", "streams": [
+                {"labels": {}, "value": 2}]},
+            "guard.rewinds": {"kind": "counter", "streams": [
+                {"labels": {}, "value": 1}]},
+            "io.bad_records": {"kind": "counter", "streams": [
+                {"labels": {}, "value": 3}]},
+        }
         for rank in range(3):
             _write_rank(tmp, rank, intervals=3,
                         slow_phase="input_wait" if rank == 2 else None,
-                        slow=0.200 if rank == 2 else 0.0)
+                        slow=0.200 if rank == 2 else 0.0,
+                        extra_metrics=guard_snap if rank == 1 else None)
         agg = fleet.FleetAggregator(tmp).refresh()
         summary = agg.summary()
         assert summary["ranks"] == [0, 1, 2], summary["ranks"]
@@ -246,9 +264,15 @@ def _self_test():
         # the model attributes entirely to waiting on the straggler
         assert abs(summary["max_skew_ms"] - 215.0) < 1.0, \
             summary["max_skew_ms"]
+        # guardrail counters surface per rank and flag in the table
+        pr1 = summary["per_rank"][1]
+        assert pr1["guard_trips"] == 2 and pr1["guard_rewinds"] == 1, pr1
+        assert pr1["bad_records"] == 3, pr1
+        assert summary["per_rank"][0]["guard_trips"] == 0
         table = render_table(summary)
         assert "STRAGGLER" in table and "rank 2 (input-bound)" in table, \
             table
+        assert "REWOUND×1" in table and "BADREC×3" in table, table
         for d in summary["intervals"]:
             for r, v in d["ranks"].items():
                 total = (sum(v["phases"].values())

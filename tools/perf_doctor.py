@@ -219,6 +219,47 @@ def input_advice(ranked, metrics=None):
             "input pipeline\"" % detail)
 
 
+def _counter_total(metrics, name):
+    """Total of a counter in a metrics snapshot (all label streams
+    summed), 0 when absent."""
+    try:
+        streams = (metrics or {}).get(name, {}).get("streams") or []
+        return sum(float(s.get("value") or 0.0) for s in streams)
+    except (TypeError, ValueError, AttributeError):
+        return 0.0
+
+
+def guardrail_section(metrics):
+    """Training-guardrail activity from the last metrics snapshot:
+    anomaly trips, skipped updates, rewinds, and quarantined input
+    records. None when the run tripped nothing (the common case) —
+    a silent run should not grow a section."""
+    trips = _counter_total(metrics, "guard.trips")
+    skips = _counter_total(metrics, "guard.skips")
+    rewinds = _counter_total(metrics, "guard.rewinds")
+    bad = _counter_total(metrics, "io.bad_records")
+    if not (trips or skips or rewinds or bad):
+        return None
+    out = ["== guardrails =="]
+    if trips or skips:
+        out.append(
+            "  %d anomaly trip(s), %d update(s) skipped — see "
+            "guardrail events in the run log; raise MXTPU_GUARD_ZMAX "
+            "only if these are known-benign spikes"
+            % (int(trips), int(skips)))
+    if rewinds:
+        out.append(
+            "  %d rewind(s) to last-good checkpoint — training state "
+            "was rolled back; inspect with tools/ckpt_inspect.py "
+            "--last-good" % int(rewinds))
+    if bad:
+        out.append(
+            "  %d input record(s) quarantined (io.bad_records) — see "
+            "quarantine.jsonl in the run dir for uri/ordinal of each"
+            % int(bad))
+    return "\n".join(out)
+
+
 def _step_latency_percentiles(metrics):
     """p50/p99 of fit.step_seconds from the last metrics snapshot, using
     the same bucket interpolation as the live registry (the snapshot
@@ -306,6 +347,10 @@ def fleet_section(run_dir):
             flags.append("LOST")
         if pr.get("stalled"):
             flags.append("STALLED")
+        if pr.get("guard_rewinds") or pr.get("guard_trips"):
+            flags.append("GUARD")
+        if pr.get("bad_records"):
+            flags.append("BADREC")
         out.append(
             "  rank %-3d %8.1f ms/step  mfu %-6s feed %6.1f ms/step  "
             "recompiles %-3d %s" % (
@@ -410,6 +455,10 @@ def report(path, keep_all=False):
     kc = kernel_candidates_section(op_costs, anatomy)
     if kc:
         out += ["", kc]
+
+    guard = guardrail_section(metrics)
+    if guard:
+        out += ["", guard]
 
     pcts = _step_latency_percentiles(metrics)
     if pcts:
@@ -537,8 +586,28 @@ def _self_test():
     assert "workers are the bottleneck" in msg, msg
     assert input_advice(ranked) is None, ranked  # device_sync diagnosis
 
+    # guardrail section: silent run -> no section; any activity -> the
+    # matching lines, with counts summed across label streams
+    assert guardrail_section(metrics) is None
+    assert guardrail_section(None) is None
+    gtext = guardrail_section({
+        "guard.trips": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 3}]},
+        "guard.skips": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 2}]},
+        "guard.rewinds": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 1}]},
+        "io.bad_records": {"kind": "counter", "streams": [
+            {"labels": {"uri": "a"}, "value": 4},
+            {"labels": {"uri": "b"}, "value": 1}]}})
+    assert "== guardrails ==" in gtext, gtext
+    assert "3 anomaly trip(s), 2 update(s) skipped" in gtext, gtext
+    assert "1 rewind(s) to last-good checkpoint" in gtext, gtext
+    assert "5 input record(s) quarantined" in gtext, gtext
+
     text = report(path)
     assert "diagnosis: largest cost is device_sync" in text, text
+    assert "== guardrails ==" not in text, text  # silent run
     assert "input-bound" not in text, text
     assert "compute-bound" in text, text
     assert "fp32 compute on TPU" in text, text

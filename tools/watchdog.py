@@ -45,10 +45,13 @@ import tempfile
 import time
 
 # Literal mirrors of resilience/checkpoint.py EXIT_PREEMPTED/EXIT_RESHAPE
-# (sysexits-adjacent contract codes; kept literal so the decision table
-# below reads standalone).
+# and resilience/guardrail.py EXIT_GUARDRAIL (sysexits-adjacent contract
+# codes; kept literal so the decision table below reads standalone).
 EXIT_PREEMPTED = 75
 EXIT_RESHAPE = 76
+EXIT_GUARDRAIL = 78
+
+GUARDRAIL_VERDICT_FILE = "guardrail_verdict.json"
 
 
 def find_latest_checkpoint(prefix):
@@ -89,6 +92,11 @@ def decide(rc, lost, restarts, max_restarts, world, elastic):
     of ``"done" | "shrink" | "retry" | "fail"``.
 
     * ``rc == 0`` — done.
+    * ``rc == EXIT_GUARDRAIL`` (78) — fail immediately, whatever the
+      remaining budget: the training process itself declared the run
+      numerically unrecoverable (rewind budget exhausted). Replaying
+      the same data through the same model diverges the same way —
+      restarts cannot fix poisoned data.
     * elastic, with lost rank(s) and at least one survivor — shrink to
       the surviving world. Shrinking does NOT consume the restart
       budget: losing capacity is the expected steady state of a
@@ -100,6 +108,8 @@ def decide(rc, lost, restarts, max_restarts, world, elastic):
     """
     if rc == 0:
         return ("done", world)
+    if rc == EXIT_GUARDRAIL:
+        return ("fail", world)
     lost = set(lost)
     if elastic and lost and world - len(lost) >= 1:
         return ("shrink", world - len(lost))
@@ -126,6 +136,31 @@ def fleet_evidence(run_dir):
     except Exception as exc:  # noqa: BLE001 — evidence must not kill
         out["aggregator_error"] = str(exc)  # the supervisor
     return out
+
+
+def _record_guardrail(run_dir, rc):
+    """On an ``EXIT_GUARDRAIL`` death, lift the structured verdict the
+    training process published (``guardrail_verdict.json``) into
+    ``decisions.jsonl`` as its own ``{"type": "guardrail"}`` line, so
+    the terminal ``fail`` decision that follows sits next to the reason
+    the run was declared unrecoverable. Returns the record, or None."""
+    if not run_dir or rc != EXIT_GUARDRAIL:
+        return None
+    try:
+        with open(os.path.join(run_dir, GUARDRAIL_VERDICT_FILE)) as f:
+            verdict = json.load(f)
+    except (OSError, ValueError):
+        verdict = None
+    record = dict(verdict) if isinstance(verdict, dict) else {}
+    record["type"] = "guardrail"
+    record["rc"] = rc
+    record.setdefault("t", time.time())
+    try:
+        with open(os.path.join(run_dir, "decisions.jsonl"), "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        pass
+    return record
 
 
 def _record_decision(run_dir, action, rc, stalled, lost, restarts, world,
@@ -251,6 +286,7 @@ def supervise(command, max_restarts=2, num_workers=0,
         lost = []
         if elastic and run_dir:
             lost = sorted(hb.tombstoned(run_dir) | lost_seen)
+        _record_guardrail(run_dir, rc)
         action, new_world = decide(rc if not stalled else (rc or 1),
                                    lost, restarts, max_restarts,
                                    world or 0, elastic)
@@ -295,6 +331,10 @@ def _self_test():
         ("fail", 8)
     # elastic off: a tombstone changes nothing
     assert decide(EXIT_RESHAPE, [3], 0, 2, 8, False) == ("retry", 8)
+    # guardrail verdict (exit 78): terminal no matter the budget, and
+    # it outranks a simultaneous lost-rank shrink vote
+    assert decide(EXIT_GUARDRAIL, [], 0, 5, 8, False) == ("fail", 8)
+    assert decide(EXIT_GUARDRAIL, [3], 0, 5, 8, True) == ("fail", 8)
 
     # -- end-to-end: lose a rank, shrink, finish ------------------------
     tmp = tempfile.mkdtemp(prefix="mxtpu_watchdog_selftest_")
@@ -368,6 +408,36 @@ def _self_test():
                        poll_interval=0.05, log=msgs.append)
         assert rc == 7, (rc, msgs)
         assert any("giving up" in m for m in msgs), msgs
+
+        # -- end-to-end: guardrail verdict stops retries cold -----------
+        script4 = os.path.join(tmp, "job4.py")
+        with open(script4, "w") as f:
+            f.write(
+                "import json, os, sys\n"
+                "run = os.environ['MXTPU_RUN_DIR']\n"
+                "with open(os.path.join(run, %r), 'w') as fh:\n"
+                "    json.dump({'type': 'guardrail', 'action': 'abort',\n"
+                "               'reason': 'loss anomaly at step 9',\n"
+                "               'step': 9, 'rewinds': 2, 'budget': 2},\n"
+                "              fh)\n"
+                "sys.exit(%d)\n"
+                % (GUARDRAIL_VERDICT_FILE, EXIT_GUARDRAIL))
+        msgs = []
+        rc = supervise([sys.executable, script4], max_restarts=3,
+                       world=4, elastic=True,
+                       run_dir=os.path.join(tmp, "run4"),
+                       poll_interval=0.05, log=msgs.append)
+        assert rc == EXIT_GUARDRAIL, (rc, msgs)
+        assert any("giving up" in m for m in msgs), msgs
+        with open(os.path.join(tmp, "run4", "decisions.jsonl")) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        kinds = [r["type"] for r in records]
+        assert kinds == ["guardrail", "decision"], kinds
+        assert records[0]["reason"] == "loss anomaly at step 9", records[0]
+        assert records[0]["rc"] == EXIT_GUARDRAIL, records[0]
+        assert records[1]["action"] == "fail", records[1]
+        # the budget was never touched: one launch, zero restarts
+        assert records[1]["restarts"] == 0, records[1]
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     print("watchdog self-test passed")

@@ -263,6 +263,22 @@ def main():
                           "speedup": out["input_pipeline"].get(
                               "process_vs_thread_speedup")}),
               file=sys.stderr)
+    if os.environ.get("SCORE_SERVE", "0") == "1":
+        # ISSUE 20 rider: serving-path leg — continuous batching vs
+        # sequential dispatch (>= 3x gate at max_batch=8), open-loop
+        # Poisson p50/p99 latency, KV-cached decode tokens/s, int8
+        # parity, and the zero-steady-state-recompile proof, all in the
+        # same BENCH artifact (full run in benchmarks/serving_bench.py)
+        from benchmarks.serving_bench import run_serving_bench
+
+        out["serving"] = run_serving_bench(smoke=SMOKE)
+        print(json.dumps({
+            "serving_speedup": out["serving"]["closed_loop"]["speedup"],
+            "p50_ms": out["serving"]["open_loop"]["latency_p50_ms"],
+            "p99_ms": out["serving"]["open_loop"]["latency_p99_ms"],
+            "tokens_per_sec": out["serving"]["decode"]["tokens_per_sec"],
+            "recompiles": out["serving"]["steady_state_recompiles"],
+        }), file=sys.stderr)
     run_dir = os.environ.get("MXTPU_RUN_DIR")
     if run_dir and glob.glob(os.path.join(run_dir, "telemetry_r*.jsonl")):
         # ISSUE 16 rider: fleet skew next to MFU — when the bench ran

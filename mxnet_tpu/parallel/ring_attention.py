@@ -16,8 +16,6 @@ block has attended to every KV block, with online softmax accumulation
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -89,14 +87,26 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
     return num / jnp.maximum(den_t, 1e-30)
 
 
-def sequence_parallel_attention(q, k, v, mesh, causal=True):
-    """Convenience wrapper: shard_map ring_attention over mesh axis 'sp'."""
+def sequence_parallel_attention(q, k, v, mesh, causal=True, q_offset=0):
+    """Convenience wrapper: shard_map ring_attention over mesh axis 'sp'.
+
+    ``q_offset`` shifts every query's global position by a constant —
+    the chunked-prefill continuation hook: when a serving engine
+    prefills a long prompt in sequence chunks, a later chunk's queries
+    sit at ``q_offset = chunk_start`` while its KV ring is local, so
+    the causal mask keeps absolute-position semantics across chunks."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
+    def _shard_fn(qb, kb, vb):
+        idx = jax.lax.axis_index("sp")
+        return ring_attention(
+            qb, kb, vb, axis_name="sp", causal=causal,
+            q_offset=q_offset + idx * qb.shape[1])
+
     spec = P(None, "sp", None, None)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        _shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

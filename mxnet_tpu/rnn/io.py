@@ -8,13 +8,13 @@ per-bucket matrix + a flat (bucket, row-offset) schedule.
 """
 from __future__ import annotations
 
-import bisect
 import random
 
 import numpy as np
 
 from .. import ndarray as nd
 from ..io import DataBatch, DataIter, DataDesc
+from ..serving import buckets as _buckets
 
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
@@ -59,12 +59,15 @@ class BucketSentenceIter(DataIter):
         per_bucket = [[] for _ in self.buckets]
         n_discarded = 0
         for sentence in sentences:
-            slot = bisect.bisect_left(self.buckets, len(sentence))
-            if slot == len(self.buckets):
+            # smallest covering bucket — shared with the serving queue
+            # (serving/buckets.py is the one implementation of this rule)
+            slot = _buckets.smallest_covering(self.buckets, len(sentence))
+            if slot is None:
                 n_discarded += 1
                 continue
-            row = np.full((self.buckets[slot],), invalid_label, dtype=dtype)
-            row[:len(sentence)] = sentence
+            row = _buckets.pad_to_width(
+                np.asarray(sentence, dtype=dtype), self.buckets[slot],
+                invalid_label)
             per_bucket[slot].append(row)
         # (0, width) for empty buckets keeps label shifting uniform
         self.data = [np.asarray(rows, dtype=dtype).reshape(-1, width)

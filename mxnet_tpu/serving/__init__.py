@@ -1,0 +1,44 @@
+"""Production inference serving (ROADMAP item 1).
+
+The predict-side analog of the training stack: an AOT-compiled
+executor pool over a small ladder of padded bucket shapes
+(``predict.Predictor.compile``), fed by a continuous-batching request
+queue (``serving.engine.ServingEngine``) and — for autoregressive
+models — a slot-based KV-cached decode loop
+(``serving.decode.GenerationEngine``). Shape bucketing lives in
+``serving.buckets`` and is shared with training (rnn/io.py,
+module/bucketing_module.py): one smallest-covering-bucket
+implementation for both sides.
+
+Import is jax-light: the engine/decode modules (which pull in jax)
+load lazily on first attribute access.
+"""
+from __future__ import annotations
+
+from . import buckets  # noqa: F401  (pure numpy/bisect — always safe)
+
+_LAZY = {
+    "engine": ".engine",
+    "decode": ".decode",
+    "quant": ".quant",
+    "ServingEngine": ".engine",
+    "ServeClosed": ".engine",
+    "GenerationEngine": ".decode",
+}
+
+__all__ = ["buckets"] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        leaf = _LAZY[name].lstrip(".")
+        if name == leaf:
+            value = mod
+        else:
+            value = getattr(mod, name)
+        globals()[name] = value
+        return value
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -260,6 +260,80 @@ def guardrail_section(metrics):
     return "\n".join(out)
 
 
+def _hist_percentiles(metrics, name, qs=(50, 99)):
+    """Percentiles of any histogram in a metrics snapshot (label streams
+    aggregated), or None when absent/empty."""
+    hist = (metrics or {}).get(name)
+    if not hist:
+        return None
+    agg_counts, agg_sum, agg_n, buckets = None, 0.0, 0, None
+    for stream in hist.get("streams", []):
+        b = stream.get("buckets")
+        c = stream.get("counts")
+        if not b or not c:
+            continue
+        if agg_counts is None:
+            buckets, agg_counts = b, list(c)
+        elif b == buckets:
+            agg_counts = [x + y for x, y in zip(agg_counts, c)]
+        agg_sum += stream.get("sum", 0.0)
+        agg_n += stream.get("count", 0)
+    if not agg_n or buckets is None:
+        return None
+    return tuple(percentile_from_counts(buckets, agg_counts, agg_n,
+                                        agg_sum, q) for q in qs)
+
+
+def serving_section(metrics):
+    """Serving-engine activity from the last metrics snapshot:
+    per-request latency percentiles split into queue-wait vs end-to-end,
+    batch occupancy, and the KV-decode token counters. None when the
+    process served nothing (training runs should not grow a section)."""
+    reqs = _counter_total(metrics, "serve.requests")
+    gens = _counter_total(metrics, "serve.gen_requests")
+    toks = _counter_total(metrics, "serve.tokens")
+    if not (reqs or gens or toks):
+        return None
+    out = ["== serving =="]
+    if reqs:
+        batches = _counter_total(metrics, "serve.batches")
+        pad = _counter_total(metrics, "serve.pad_rows")
+        occ = reqs / (reqs + pad) if (reqs + pad) else 0.0
+        out.append(
+            "  %d request(s) in %d batch(es), mean occupancy %.0f%% "
+            "(%d padding rows wasted)"
+            % (int(reqs), int(batches), 100.0 * occ, int(pad)))
+        e2e = _hist_percentiles(metrics, "serve.e2e_seconds")
+        wait = _hist_percentiles(metrics, "serve.queue_wait_seconds")
+        if e2e:
+            out.append("  latency p50=%.2f ms p99=%.2f ms (e2e)"
+                       % (1000.0 * e2e[0], 1000.0 * e2e[1]))
+        if e2e and wait:
+            out.append("  queue wait p50=%.2f ms p99=%.2f ms"
+                       % (1000.0 * wait[0], 1000.0 * wait[1]))
+            if wait[1] > 0.5 * e2e[1] and e2e[1] > 0:
+                out.append(
+                    "  p99 is queue-dominated — raise "
+                    "MXTPU_SERVE_MAX_BATCH or add replicas; lowering "
+                    "MXTPU_SERVE_BATCH_TIMEOUT_MS only helps p50")
+        if occ and occ < 0.5 and batches > 1:
+            out.append(
+                "  occupancy under 50%% — batches dispatch mostly "
+                "empty; raise MXTPU_SERVE_BATCH_TIMEOUT_MS to collect "
+                "more co-riders per bucket")
+    if gens or toks:
+        pre = _hist_percentiles(metrics, "serve.prefill_seconds")
+        dec = _hist_percentiles(metrics, "serve.decode_step_seconds")
+        line = "  decode: %d generation(s), %d token(s)" % (
+            int(gens), int(toks))
+        if pre:
+            line += ", prefill p50=%.2f ms" % (1000.0 * pre[0])
+        if dec:
+            line += ", decode step p50=%.2f ms" % (1000.0 * dec[0])
+        out.append(line)
+    return "\n".join(out)
+
+
 def _step_latency_percentiles(metrics):
     """p50/p99 of fit.step_seconds from the last metrics snapshot, using
     the same bucket interpolation as the live registry (the snapshot
@@ -399,6 +473,11 @@ def report(path, keep_all=False):
     if fleet_text:
         out = [fleet_text, ""] + out
     if not anatomy:
+        # a pure serving process has no fit-loop anatomy intervals but
+        # still deserves its latency/occupancy summary
+        serve = serving_section(metrics)
+        if serve:
+            out += ["", serve]
         return "\n".join(out)
 
     out += ["", "== MFU trajectory ==", format_mfu_trajectory(anatomy)]
@@ -459,6 +538,10 @@ def report(path, keep_all=False):
     guard = guardrail_section(metrics)
     if guard:
         out += ["", guard]
+
+    serve = serving_section(metrics)
+    if serve:
+        out += ["", serve]
 
     pcts = _step_latency_percentiles(metrics)
     if pcts:
@@ -604,6 +687,40 @@ def _self_test():
     assert "3 anomaly trip(s), 2 update(s) skipped" in gtext, gtext
     assert "1 rewind(s) to last-good checkpoint" in gtext, gtext
     assert "5 input record(s) quarantined" in gtext, gtext
+
+    # serving section: silent for a training run; latency + occupancy +
+    # decode lines when the snapshot carries serve.* activity
+    assert serving_section(metrics) is None
+    assert serving_section(None) is None
+    lat_buckets = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0]
+    stext = serving_section({
+        "serve.requests": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 90}]},
+        "serve.batches": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 15}]},
+        "serve.pad_rows": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 30}]},
+        "serve.e2e_seconds": {"kind": "histogram", "streams": [
+            {"labels": {}, "count": 90, "sum": 90 * 0.004,
+             "counts": [0, 0, 45, 40, 5, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                        0, 0],
+             "buckets": lat_buckets}]},
+        "serve.queue_wait_seconds": {"kind": "histogram", "streams": [
+            {"labels": {}, "count": 90, "sum": 90 * 0.003,
+             "counts": [0, 0, 50, 38, 2, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                        0, 0],
+             "buckets": lat_buckets}]},
+        "serve.gen_requests": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 4}]},
+        "serve.tokens": {"kind": "counter", "streams": [
+            {"labels": {}, "value": 64}]}})
+    assert "== serving ==" in stext, stext
+    assert "90 request(s) in 15 batch(es), mean occupancy 75%" in stext, \
+        stext
+    assert "latency p50=" in stext and "p99=" in stext, stext
+    assert "queue-dominated" in stext, stext
+    assert "decode: 4 generation(s), 64 token(s)" in stext, stext
 
     text = report(path)
     assert "diagnosis: largest cost is device_sync" in text, text
